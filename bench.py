@@ -1,0 +1,147 @@
+"""Benchmark: pretraining train-step throughput + MFU on the flagship GPTDolomite model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.md); the driver north star is >= 40% MFU
+for pretraining. vs_baseline therefore reports achieved MFU / 0.40.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# v5e peak bf16 TFLOP/s per chip (v5litepod). Other platforms for local fallback runs.
+_PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+
+    from dolomite_engine_tpu.enums import LRDecaySchedule, Mode
+    from dolomite_engine_tpu.model_wrapper.pretraining import ModelWrapperForPretraining
+    from dolomite_engine_tpu.optimization import get_optimizer, get_scheduler
+    from dolomite_engine_tpu.parallel.mesh import MeshManager, named_sharding
+    from dolomite_engine_tpu.train_utils import get_model_tflops, make_train_step
+    from dolomite_engine_tpu.distributed import create_sharded_train_state
+
+    if on_tpu:
+        seq, micro_bs, accum = 2048, 8, 1
+        config = dict(
+            model_type="gpt_dolomite",
+            vocab_size=50304,
+            n_positions=seq,
+            n_embd=1024,
+            n_layer=24,
+            n_head=16,
+            num_key_value_heads=8,
+            attention_head_type="gqa",
+            position_embedding_type="rope",
+            activation_function="swiglu",
+            normalization_function="rmsnorm",
+            add_bias=False,
+            resid_pdrop=0.0,
+            embd_pdrop=0.0,
+            attn_pdrop=0.0,
+            tie_word_embeddings=True,
+        )
+        dtype = "bf16"
+        steps = 20
+    else:
+        seq, micro_bs, accum = 256, 2, 1
+        config = dict(
+            model_type="gpt_dolomite",
+            vocab_size=1024,
+            n_positions=seq,
+            n_embd=128,
+            n_layer=4,
+            n_head=4,
+            attention_head_type="mqa",
+            position_embedding_type="rope",
+            activation_function="swiglu",
+            normalization_function="rmsnorm",
+            resid_pdrop=0.0,
+            embd_pdrop=0.0,
+            attn_pdrop=0.0,
+        )
+        dtype = "fp32"
+        steps = 3
+
+    MeshManager()
+    mesh = MeshManager.get_mesh()
+
+    from dolomite_engine_tpu.enums import AttentionImplementation
+
+    wrapper = ModelWrapperForPretraining(
+        mode=Mode.training,
+        pretrained_config=config,
+        dtype=dtype,
+        sequence_length=seq,
+        attention_implementation=(
+            AttentionImplementation.flash_attention_2 if on_tpu else AttentionImplementation.sdpa
+        ),
+        reset_attention_mask=False,
+        zero_stage=3,
+    )
+
+    sched = get_scheduler(10, 0, None, 1000, LRDecaySchedule.cosine, 0.1, base_lr=3e-4)
+    opt = get_optimizer(
+        "TorchAdamW", {"weight_decay": 0.1, "betas": (0.9, 0.95), "eps": 1e-10}, sched
+    )
+    state, _ = create_sharded_train_state(wrapper, opt, mesh, jax.random.PRNGKey(0))
+
+    def loss_fn(params, micro, rng):
+        return wrapper.loss(params, micro["text"], train=True)
+
+    step_fn = make_train_step(loss_fn, opt, gradient_accumulation_steps=accum)
+    tokens = np.random.RandomState(0).randint(
+        0, config["vocab_size"], size=(accum, micro_bs, seq + 1)
+    ).astype(np.int32)
+
+    with mesh:
+        jit_step = jax.jit(step_fn, donate_argnums=0)
+        batch = {"text": jax.device_put(jnp.asarray(tokens), named_sharding(None, ("dp", "fsdp")))}
+        rng = jax.random.PRNGKey(1)
+
+        # warmup / compile
+        state, metrics = jit_step(state, batch, rng)
+        jax.block_until_ready(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = jit_step(state, batch, jax.random.fold_in(rng, i))
+        jax.block_until_ready(metrics["loss"])
+        elapsed = time.perf_counter() - t0
+
+    step_time = elapsed / steps
+    tokens_per_step = accum * micro_bs * seq
+    tokens_per_sec = tokens_per_step / step_time
+    n_devices = jax.device_count()
+
+    model_tflops = get_model_tflops(wrapper.config, accum * micro_bs, seq)
+    achieved_tflops = model_tflops / step_time / n_devices
+    peak = _PEAK_TFLOPS.get(backend, 100.0)
+    mfu = achieved_tflops / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "pretrain_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec / n_devices, 2),
+                "unit": f"tokens/s/chip ({backend}, mfu={mfu:.3f}, step={step_time*1e3:.1f}ms)",
+                "vs_baseline": round(mfu / 0.40, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit a parseable line
+        print(json.dumps({"metric": "bench_error", "value": 0, "unit": str(e)[:200], "vs_baseline": 0}))
+        sys.exit(1)

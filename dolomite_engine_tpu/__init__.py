@@ -1,0 +1,10 @@
+"""dolomite_engine_tpu: a TPU-native (JAX/XLA/Pallas/pjit) LLM pretraining + finetuning +
+generation + checkpoint-unsharding framework with the capabilities of ibm-granite/dolomite-engine.
+
+Reference parity map: see SURVEY.md at the repo root. The reference is CUDA/torch; this framework
+is a ground-up JAX design: one `jax.sharding.Mesh` over (dp, fsdp, sp, tp, ep) replaces
+ProcessGroupManager + FSDP + DTensor (reference: dolomite_engine/utils/parallel.py), GSPMD-inserted
+collectives replace NCCL calls, optax replaces torch.optim, and Orbax replaces torch DCP.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,619 @@
+"""Config/flag system: one YAML -> typed pydantic tree, per-mode roots.
+
+Parity: reference `dolomite_engine/arguments.py` (602 LoC) — same class names, field names,
+defaults, and cross-field validation (`model_post_init` hooks), so reference YAML configs parse
+unchanged. TPU-specific deltas, all backward compatible:
+  - `DistributedArgs.distributed_backend`: torch/deepspeed accepted and coerced to `jax`
+    (ZeRO stages map to sharding specs; see `parallel/sharding.py`).
+  - `DistributedArgs` gains `context_parallel_size` and `expert_parallel_size` (reference has
+    neither CP nor real EP, SURVEY §2.6).
+  - `ModelArgs.model_class` stays a string ("AutoModelForCausalLM"/"AutoModelForSeq2SeqLM")
+    resolved against this framework's registry instead of transformers classes.
+  - `torch_compile` / `fsdp_algorithm` / ZeRO++ quantization flags are accepted no-ops (XLA
+    always compiles; FSDP1-vs-2 is meaningless under GSPMD) — warned, not errored.
+"""
+
+from __future__ import annotations
+
+import logging
+from argparse import ArgumentParser
+from enum import Enum
+from typing import Any
+
+from .defaults import INPUT_FORMAT, OUTPUT_FORMAT
+from .enums import (
+    AttentionImplementation,
+    DistributedBackend,
+    ExperimentsTrackerName,
+    FP8Backend,
+    GradientCheckpointingMethod,
+    LossMask,
+    LRDecaySchedule,
+    Mode,
+    ParamsGroupMethod,
+    TuningMethod,
+)
+from .utils import BaseArgs, load_yaml, log_rank_0, normalize_dtype_string, run_rank_n, set_logger
+
+
+def _check_not_None(object_name_list: list[tuple[Any, str]]) -> None:
+    for obj, name in object_name_list:
+        assert obj is not None, f"{name} cannot be None"
+
+
+class PromptTuningInit(Enum):
+    RANDOM = "RANDOM"
+    TEXT = "TEXT"
+
+
+class RandomArgs(BaseArgs):
+    # random seed
+    seed: int = 42
+
+
+class TokenizerArgs(BaseArgs):
+    # override model's tokenizer with this
+    tokenizer_name: str | None = None
+    # add special tokens to the tokenizer
+    additional_special_tokens: list[str] | None = None
+
+
+class ModelArgs(BaseArgs):
+    # model name on huggingface hub (or local path)
+    model_name: str | None = None
+    # config dict to build the model from scratch
+    pretrained_config: dict | None = None
+    # model family class: AutoModelForCausalLM / AutoModelForSeq2SeqLM
+    model_class: str = None
+    # trust remote code (accepted for config compat; unused by the JAX registry)
+    trust_remote_code: bool = False
+    # attention implementation
+    attention_implementation: AttentionImplementation | None = None
+    # padding-free transformer: packed sequences + segment-ids attention
+    use_padding_free_transformer: bool = False
+    # low-memory init (JAX always does abstract init + sharded materialization; accepted no-op)
+    efficient_initialization: bool = False
+    # whether to reset attention masks at document boundaries for pretraining
+    reset_attention_mask: bool = False
+    # whether to reset position ids at document boundaries for pretraining
+    reset_position_ids: bool = False
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.model_class, "model_class")])
+
+        if self.model_name is None:
+            _check_not_None([(self.pretrained_config, "pretrained_config")])
+        else:
+            assert self.pretrained_config is None, (
+                "pretrained_config shouldn't be specified with model_name"
+            )
+
+        assert self.model_class in ["AutoModelForCausalLM", "AutoModelForSeq2SeqLM"], (
+            f"unexpected model_class ({self.model_class})"
+        )
+
+
+class PromptTuningArgs(BaseArgs):
+    # prompt tuning init method
+    prompt_tuning_init: PromptTuningInit = None
+    # prompt tuning init text
+    prompt_tuning_init_text: str | None = None
+    # number of virtual tokens for PEFT
+    num_virtual_tokens: int | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.prompt_tuning_init, "prompt_tuning_init")])
+
+        if self.prompt_tuning_init == PromptTuningInit.RANDOM:
+            assert self.prompt_tuning_init_text is None, (
+                f"prompt_tuning_init_text '{self.prompt_tuning_init_text}' was specified "
+                "with RANDOM init method"
+            )
+        elif self.prompt_tuning_init == PromptTuningInit.TEXT:
+            assert self.prompt_tuning_init_text is not None, (
+                "prompt_tuning_init_text needs to be specified with TEXT init method"
+            )
+
+
+class LoRAArgs(BaseArgs):
+    # lora rank
+    lora_rank: int = None
+    # the scaling factor for the low-rank matrices
+    lora_alpha: float = 32.0
+    # the dropout probability of the LoRA layers
+    lora_dropout: float = 0.1
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.lora_rank, "lora_rank")])
+
+
+class TuningArgs(BaseArgs):
+    # type of tuning, full finetuning or PEFT
+    tuning_method: TuningMethod = None
+    # prompt tuning related arguments
+    prompt_tuning_args: PromptTuningArgs | None = None
+    # lora related arguments
+    lora_args: LoRAArgs | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.tuning_method, "tuning_method")])
+
+        if self.tuning_method in [TuningMethod.full_finetuning, TuningMethod.pretraining]:
+            assert self.prompt_tuning_args is None, (
+                "prompt_tuning_args should not be specified with full_finetuning or pretraining"
+            )
+            assert self.lora_args is None, (
+                "lora_args should not be specified with full_finetuning or pretraining"
+            )
+        elif self.tuning_method == TuningMethod.prompt_tuning:
+            assert self.lora_args is None, "lora_args should not be specified with prompt_tuning"
+        elif self.tuning_method == TuningMethod.lora:
+            assert self.prompt_tuning_args is None, (
+                "prompt_tuning_args should not be specified with lora"
+            )
+
+    def get_num_virtual_tokens(self) -> int:
+        return (
+            self.prompt_tuning_args.num_virtual_tokens
+            if self.tuning_method == TuningMethod.prompt_tuning
+            else 0
+        )
+
+
+class TrainingParameters(BaseArgs):
+    # whether to use sequential sampler for validation
+    ignore_sampling_proportion_for_validation: bool = False
+    # number of training steps
+    num_training_steps: int | None = None
+    # gradient accumulation steps
+    gradient_accumulation_steps: int = 1
+    # interval for evaluation
+    eval_interval: int | None = None
+    # batch size per device for ZeRO-DP
+    micro_batch_size: int = None
+    # whether to use val dataset for validation during training
+    eval_during_training: bool = True
+    # masking methodology of loss function input
+    loss_mask: LossMask = LossMask.output_only
+    # gradient clip value
+    gradient_clipping: float | None = 1
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None(
+            [
+                (self.num_training_steps, "num_training_steps"),
+                (self.micro_batch_size, "micro_batch_size"),
+            ]
+        )
+
+        if self.eval_during_training:
+            _check_not_None([(self.eval_interval, "eval_interval")])
+
+
+class SaveArgs(BaseArgs):
+    # path to save checkpoints
+    save_path: str = None
+    # interval for checkpointing
+    save_interval: int = None
+    # whether to save optimizer
+    save_optimizer: bool = True
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.save_path, "save_path"), (self.save_interval, "save_interval")])
+
+
+class LoadArgs(BaseArgs):
+    # path to load checkpoints
+    load_path: str = None
+    # iteration to load
+    iteration: int | None = None
+    # whether to load optimizer
+    load_optimizer: bool = True
+    # whether to load lr_scheduler
+    load_lr_scheduler: bool = True
+    # whether to load rng state
+    load_rng_state: bool = True
+    # whether to resume dataloader
+    load_dataloader_state: bool = True
+    # whether to resume experiments tracker
+    load_experiments_tracker_state: bool = True
+    # whether to load starting iteration
+    load_starting_iteration: bool = True
+    # whether to resume learning rate during training (NO-OP when loading lr scheduler)
+    resume_learning_rate: bool = True
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.load_path, "load_path")])
+
+        if not self.load_optimizer:
+            assert not self.load_lr_scheduler, (
+                "lr_scheduler loading doesn't make sense if you aren't loading optimizer"
+            )
+
+        if self.load_lr_scheduler:
+            assert self.resume_learning_rate, (
+                "resume learning rate needs to be True when reloading LR scheduler"
+            )
+
+
+class DatasetArgs(BaseArgs):
+    # dataset class
+    class_name: str = None
+    # class args for dataset
+    class_args: dict = {}
+    # dataset name
+    data_name: str = None
+    # formatting to use for input
+    input_format: str = INPUT_FORMAT
+    # formatting to use for output
+    output_format: str = OUTPUT_FORMAT
+    # data sampling proportions
+    data_sampling_ratio: int = None
+    # max tokens for input text
+    max_input_tokens: int | None = None
+    # max tokens for output text
+    max_output_tokens: int | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.class_name, "dataset class_name"), (self.data_name, "data_name")])
+
+        if self.data_sampling_ratio is not None:
+            assert self.data_sampling_ratio > 0, "data_sampling_ratio should be a positive integer"
+
+
+class OptimizerArgs(BaseArgs):
+    # optimizer class
+    class_name: str = "TorchAdamW"
+    # how to create param groups
+    params_group_method: ParamsGroupMethod | None = None
+    # class args for optimizer
+    class_args: dict = {
+        "lr": 1e-5,
+        "weight_decay": 0.1,
+        "betas": [0.9, 0.95],
+        "eps": 1e-10,
+    }
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.class_name, "optimizer class_name")])
+
+
+class LRSchedulerArgs(BaseArgs):
+    # warmup steps
+    num_warmup_steps: int = 200
+    # constant steps after warmup and before decay
+    num_constant_steps: int = 0
+    # decay steps after constant steps, if None then all remaining steps are for decay
+    num_decay_steps: int | None = None
+    # lr scheduler for decay
+    lr_decay_style: LRDecaySchedule = LRDecaySchedule.cosine
+    # decay factor * max_lr = min_lr
+    lr_decay_factor: float = 0.1
+    # coefficients for advanced LR schedules (power: {"a", "b", "c"})
+    extra_lr_scheduler_args: dict = {}
+
+
+class MixedPrecisionArgs(BaseArgs):
+    # dtype to use for training / inference
+    dtype: str = "fp32"
+    # fp8 backend (accepted for config compat; TPU fp8 rides XLA fp8 dots)
+    fp8_backend: FP8Backend | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        self.dtype = normalize_dtype_string(self.dtype)
+
+        if self.dtype != "fp8":
+            assert self.fp8_backend is None, "fp8_backend specified without fp8 dtype"
+
+
+class ZeroTopologyArgs(BaseArgs):
+    # devices to use for replication
+    data_parallel_replication_world_size: int | None = None
+    # devices to use for sharding
+    data_parallel_sharding_world_size: int | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        if self.data_parallel_replication_world_size is None:
+            assert self.data_parallel_sharding_world_size is None, (
+                "data_parallel_replication_world_size needs to be specified with "
+                "data_parallel_sharding_world_size"
+            )
+        else:
+            assert self.data_parallel_sharding_world_size is not None, (
+                "data_parallel_sharding_world_size needs to be specified with "
+                "data_parallel_replication_world_size"
+            )
+
+
+class DistributedArgs(BaseArgs):
+    # ZeRO stage (0 = DDP, 1/2 = opt-state sharding, 3 = full param sharding)
+    stage: int = 3
+    # distributed backend; torch/deepspeed are coerced to jax
+    distributed_backend: DistributedBackend = DistributedBackend.jax
+    # overlap communication with computation (XLA latency-hiding scheduler)
+    overlap_comm: bool = False
+    # accepted no-op (GPU memory layout knob)
+    contiguous_gradients: bool = False
+    # CPU offloading (accepted; maps to host-offloaded optimizer state when enabled)
+    cpu_offload: bool = False
+    # gradient checkpointing method
+    gradient_checkpointing_method: GradientCheckpointingMethod | None = None
+    # gradient checkpointing args ({"checkpoint_every": k})
+    gradient_checkpointing_args: dict = {}
+    # zero topology
+    zero_topology: ZeroTopologyArgs = ZeroTopologyArgs()
+    # ZeRO++ knobs: accepted no-ops on TPU
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # communication dtype
+    communication_dtype: str | None = None
+    # accepted no-op: XLA always compiles
+    torch_compile: bool = False
+    # whether to use a dispatching dataloader (per-host sharded feed is the TPU default;
+    # flag accepted for config compat)
+    dispatching_dataloader: bool = False
+    # tensor parallel world size
+    tensor_parallel_size: int = 1
+    # tensor parallel embeddings (vocab-parallel lm head + sharded loss)
+    tensor_parallel_word_embeddings: bool = False
+    # Megatron-style sequence parallel (activation seq sharding inside TP region)
+    sequence_parallel: bool = False
+    # context parallel world size (ring/all-gather KV sequence parallelism; TPU extension)
+    context_parallel_size: int = 1
+    # expert parallel world size for MoE (TPU extension; reference only TP-shards experts)
+    expert_parallel_size: int = 1
+    # data parallel world size
+    data_parallel_size: int | None = None
+    # distributed timeout in minutes
+    timeout_minutes: int | None = None
+    # accepted no-op (FSDP1 vs FSDP2 is meaningless under GSPMD)
+    fsdp_algorithm: int = 1
+
+    def model_post_init(self, __context: Any) -> None:
+        if self.distributed_backend != DistributedBackend.jax:
+            log_rank_0(
+                logging.WARNING,
+                f"distributed_backend '{self.distributed_backend.value}' coerced to 'jax' "
+                "(XLA/GSPMD is the only backend on TPU)",
+            )
+            self.distributed_backend = DistributedBackend.jax
+
+        if self.communication_dtype is not None:
+            self.communication_dtype = normalize_dtype_string(self.communication_dtype)
+
+        if self.sequence_parallel:
+            assert self.tensor_parallel_size > 1, (
+                "tensor parallel needs to be enabled for sequence parallel"
+            )
+
+
+class AimArgs(BaseArgs):
+    # aim repo, experiment logs are saved here
+    repo: str = None
+    # name of the experiment
+    experiment: str = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.repo, "repo"), (self.experiment, "experiment")])
+
+
+class WandBArgs(BaseArgs):
+    # wandb project
+    project: str = None
+    # name of the experiment
+    name: str = None
+    # wandb entity
+    entity: str | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.project, "project"), (self.name, "name")])
+
+
+class LoggingArgs(BaseArgs):
+    # logging level
+    logging_level: str = "INFO"
+    # log interval
+    log_interval: int = 1
+    # arguments if using aim
+    aim_args: AimArgs | None = None
+    # arguments if using wandb
+    wandb_args: WandBArgs | None = None
+    # experiment tracker to use (aim or wandb)
+    experiments_tracker_name: ExperimentsTrackerName | None = None
+    # whether to use colored logs
+    use_colored_logs: bool = False
+    # profiler trace path; specifying a path enables jax.profiler traces
+    # (reference: torch profiler, `train_utils.py:182-194`)
+    torch_profiler_trace_path: str | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        if self.experiments_tracker_name == ExperimentsTrackerName.aim:
+            _check_not_None([(self.aim_args, "aim_args")])
+        elif self.experiments_tracker_name == ExperimentsTrackerName.wandb:
+            _check_not_None([(self.wandb_args, "wandb_args")])
+
+
+class ResearchArgs(BaseArgs):
+    # scalar of noise to inject into input embeddings (NEFTune, arxiv 2310.05914)
+    neft_alpha: float | None = None
+
+
+class TrainingArgs(BaseArgs):
+    # randomization related arguments
+    random_args: RandomArgs = RandomArgs()
+    # tokenizer related arguments
+    tokenizer_args: TokenizerArgs = TokenizerArgs()
+    # model related arguments
+    model_args: ModelArgs = None
+    # tuning related arguments
+    tuning_args: TuningArgs = None
+    # optimizer related arguments
+    optimizer_args: OptimizerArgs = OptimizerArgs()
+    # lr_scheduler related arguments
+    lr_scheduler_args: LRSchedulerArgs = LRSchedulerArgs()
+    # list of datasets to use
+    datasets: list[DatasetArgs] = []
+    # save related arguments
+    save_args: SaveArgs = None
+    # load related arguments
+    load_args: LoadArgs | None = None
+    # training parameters
+    training_parameters: TrainingParameters | None = None
+    # logging related arguments
+    logging_args: LoggingArgs = LoggingArgs()
+    # mixed precision related arguments
+    mixed_precision_args: MixedPrecisionArgs = MixedPrecisionArgs()
+    # distributed training related arguments
+    distributed_args: DistributedArgs = DistributedArgs()
+    # research args
+    research_args: ResearchArgs = ResearchArgs()
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None(
+            [
+                (self.model_args, "model_args"),
+                (self.tuning_args, "tuning_args"),
+                (self.save_args, "save_args"),
+                (self.datasets, "datasets"),
+            ]
+        )
+
+        _check_datasets(self.datasets)
+
+
+class GenerationParameters(BaseArgs):
+    # batch size
+    batch_size: int = None
+    # sample or greedy
+    do_sample: bool | None = None
+    # max new tokens to generate
+    max_new_tokens: int = None
+    # temperature
+    temperature: float | None = None
+    # top k
+    top_k: int | None = None
+    # top p
+    top_p: float | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None(
+            [(self.batch_size, "batch_size"), (self.max_new_tokens, "max_new_tokens")]
+        )
+
+
+class InferenceArgs(BaseArgs):
+    # randomization related arguments
+    random_args: RandomArgs = RandomArgs()
+    # tokenizer related arguments
+    tokenizer_args: TokenizerArgs = TokenizerArgs()
+    # model related arguments
+    model_args: ModelArgs | None = None
+    # list of datasets to use
+    datasets: list[DatasetArgs] = []
+    # load related arguments
+    load_args: LoadArgs | None = None
+    # generation parameters
+    generation_parameters: GenerationParameters = None
+    # mixed precision related arguments
+    mixed_precision_args: MixedPrecisionArgs = MixedPrecisionArgs()
+    # logging related arguments
+    logging_args: LoggingArgs = LoggingArgs()
+    # output dir
+    output_dir: str = None
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None(
+            [
+                (self.datasets, "datasets"),
+                (self.generation_parameters, "generation_parameters"),
+                (self.output_dir, "output_dir"),
+            ]
+        )
+
+        if self.load_args is None:
+            assert self.model_args is not None, (
+                "model_args need to be specified if load_args are not specified"
+            )
+        else:
+            assert self.model_args is None, "model_args can't be specified with load_args"
+
+        _check_datasets(self.datasets)
+
+
+class UnshardingArgs(BaseArgs):
+    # load related arguments
+    load_args: LoadArgs = None
+    # unsharded path
+    unsharded_path: str = None
+    # mixed precision related arguments
+    mixed_precision_args: MixedPrecisionArgs = MixedPrecisionArgs()
+    # logging related arguments
+    logging_args: LoggingArgs = LoggingArgs()
+
+    def model_post_init(self, __context: Any) -> None:
+        _check_not_None([(self.load_args, "load_args"), (self.unsharded_path, "unsharded_path")])
+
+
+_MODE_ARGS_MAP = {
+    Mode.training: TrainingArgs,
+    Mode.inference: InferenceArgs,
+    Mode.unsharding: UnshardingArgs,
+}
+
+
+def get_args(mode: Mode, config_path: str | None = None):
+    """Parse `--config path.yml` (or an explicit path) into the per-mode args tree."""
+    if config_path is None:
+        parser = ArgumentParser()
+        parser.add_argument("--config", type=str, required=True, help="path for the config")
+        config_path = parser.parse_args().config
+
+    config: dict = load_yaml(config_path)
+    args = _MODE_ARGS_MAP[mode](**config)
+
+    set_logger(
+        getattr(logging, args.logging_args.logging_level),
+        colored_log=args.logging_args.use_colored_logs,
+    )
+    log_args(args)
+    return args
+
+
+@run_rank_n
+def log_args(args) -> None:
+    """Sorted pretty-print of the whole arg tree (reference `arguments.py:550-595`)."""
+
+    def _iterate(node, prefix: str = "") -> list[str]:
+        result = []
+        if isinstance(node, BaseArgs):
+            node = dict(node)
+        p = len(prefix)
+        for k, v in node.items():
+            suffix = "." * max(48 - len(str(k)) - p, 3)
+            if isinstance(v, (BaseArgs, dict)):
+                if isinstance(v, dict) and len(v) == 0:
+                    result.append(f"{prefix}{k} {suffix} {{}}")
+                else:
+                    sub = _iterate(v, prefix + " " * 4)
+                    result.append(f"{prefix}{k}:\n" + "\n".join(sub))
+            elif isinstance(v, list) and all(isinstance(x, (BaseArgs, dict)) for x in v):
+                subs = ["\n".join(_iterate(x, prefix + " " * 4)) for x in v]
+                sep = "\n" + " " * (p + 4) + "*" * (44 - p) + "\n"
+                result.append(f"{prefix}{k}:\n" + sep.join(subs))
+            else:
+                result.append(f"{prefix}{k} {suffix} {v}")
+        result.sort(key=lambda x: x.lower())
+        return result
+
+    log_rank_0(logging.INFO, "------------------------ arguments ------------------------")
+    for line in _iterate(args):
+        for sub_line in line.split("\n"):
+            log_rank_0(logging.INFO, sub_line)
+    log_rank_0(logging.INFO, "-------------------- end of arguments ---------------------")
+
+
+def _check_datasets(datasets: list[DatasetArgs]) -> None:
+    assert len(datasets) != 0, "datasets cannot be an empty list"
+    assert len(datasets) == len({d.data_name for d in datasets}), (
+        "data_name should be unique for each dataset"
+    )

@@ -1,0 +1,188 @@
+"""Data subsystem: dataset registry + dataloader factories.
+
+Parity: reference `dolomite_engine/data/__init__.py` (`_DATASETS_LIST`, `get_datasets_list`,
+`get_dataloader`, `_log_dataset`). TPU deltas: there is no TP-rank-0 gating or dispatching
+broadcast — every host builds its own shard of the batch and the `ShardedDataLoader` forms
+global arrays (see `dataloader.py`).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+
+from ..enums import DatasetSplit, Mode
+from ..utils import log_rank_0
+from .base import BaseDataset, BlendedDatasets
+from .dataloader import ResumableDataLoader, ShardedDataLoader
+from .debug import DebugDataset
+from .huggingface import HuggingFaceDataset, JSONLinesDataset, SST2Dataset
+from .instruction_tuning import AlpacaDataset, DollyDataset, SlimOrcaDataset
+from .sampler import BlendedDistributedSampler
+from .utils import collate_fn, get_next_batch, infinite_iterator
+
+_DATASETS_LIST = {
+    "AlpacaDataset": AlpacaDataset,
+    "DebugDataset": DebugDataset,
+    "DollyDataset": DollyDataset,
+    "HuggingFaceDataset": HuggingFaceDataset,
+    "JSONLinesDataset": JSONLinesDataset,
+    "SlimOrcaDataset": SlimOrcaDataset,
+    "SST2Dataset": SST2Dataset,
+}
+
+
+def get_datasets_list(
+    dataset_args_list,
+    split: DatasetSplit,
+    mode: Mode,
+    tokenizer,
+    is_encoder_decoder: bool = False,
+    num_virtual_tokens: int = 0,
+) -> tuple[list[BaseDataset], list[int]]:
+    datasets_list = []
+    data_sampling_ratios = []
+    for data_args in dataset_args_list:
+        if data_args.class_name not in _DATASETS_LIST:
+            raise ValueError(f"invalid class_name ({data_args.class_name}) for dataset")
+
+        dataset = _DATASETS_LIST[data_args.class_name](
+            class_args=data_args.class_args,
+            split=split,
+            mode=mode,
+            tokenizer=tokenizer,
+            is_encoder_decoder=is_encoder_decoder,
+            data_name=data_args.data_name,
+            input_format=data_args.input_format,
+            output_format=data_args.output_format,
+            max_input_tokens=data_args.max_input_tokens,
+            max_output_tokens=data_args.max_output_tokens,
+            num_virtual_tokens=num_virtual_tokens,
+        )
+
+        if len(dataset) > 0:
+            datasets_list.append(dataset)
+            data_sampling_ratios.append(data_args.data_sampling_ratio)
+            log_rank_0(
+                logging.INFO,
+                f"examples in {dataset.__class__.__name__} ({data_args.data_name}) = "
+                f"{len(dataset)}",
+            )
+
+    assert all(i is not None for i in data_sampling_ratios) or all(
+        i is None for i in data_sampling_ratios
+    ), "either all data_sampling_ratios should be specified or all should be None"
+    if all(i is None for i in data_sampling_ratios):
+        data_sampling_ratios = [len(i) for i in datasets_list]
+
+    return datasets_list, data_sampling_ratios
+
+
+def get_dataloader(
+    args,
+    split: DatasetSplit,
+    mode: Mode,
+    tokenizer,
+    is_encoder_decoder: bool = False,
+    mesh=None,
+) -> ShardedDataLoader | None:
+    """Blended finetuning dataloader. Each host samples its own strided shard
+    (num_replicas = process_count); the ShardedDataLoader assembles global arrays."""
+    assert mode == Mode.training, "blended dataset is only supported in training mode"
+
+    datasets_list, data_sampling_ratios = get_datasets_list(
+        dataset_args_list=args.datasets,
+        split=split,
+        mode=Mode.training,
+        tokenizer=tokenizer,
+        is_encoder_decoder=is_encoder_decoder,
+        num_virtual_tokens=args.tuning_args.get_num_virtual_tokens(),
+    )
+    if len(datasets_list) == 0:
+        return None
+
+    blended_dataset = BlendedDatasets(datasets=datasets_list, split=split)
+
+    num_hosts = jax.process_count()
+    sampler = BlendedDistributedSampler(
+        dataset=blended_dataset,
+        data_sampling_ratios=[1] if len(datasets_list) == 1 else data_sampling_ratios,
+        num_replicas=num_hosts,
+        rank=jax.process_index(),
+        ignore_sampling_proportion_for_validation=(
+            args.training_parameters.ignore_sampling_proportion_for_validation
+        ),
+        shuffle=split == DatasetSplit.train,
+        seed=args.random_args.seed,
+        drop_last=False,
+    )
+
+    # per-host batch covers all addressable devices' shards of the global batch
+    dp_world = jax.device_count() // max(
+        args.distributed_args.tensor_parallel_size
+        * args.distributed_args.context_parallel_size
+        * args.distributed_args.expert_parallel_size,
+        1,
+    )
+    local_batch = args.training_parameters.micro_batch_size * dp_world // num_hosts
+
+    local_loader = ResumableDataLoader(
+        blended_dataset,
+        batch_size=max(local_batch, 1),
+        sampler=sampler,
+        collate_fn=partial(
+            collate_fn,
+            mode=mode,
+            loss_mask=args.training_parameters.loss_mask,
+            eos_token_id=tokenizer.eos_token_id,
+            is_encoder_decoder=is_encoder_decoder,
+            use_padding_free_transformer=args.model_args.use_padding_free_transformer,
+        ),
+        drop_last=True,
+    )
+
+    _log_dataset(
+        blended_dataset,
+        sampler,
+        split,
+        args.training_parameters.num_training_steps,
+        args.training_parameters.gradient_accumulation_steps,
+        args.training_parameters.micro_batch_size,
+        dp_world,
+    )
+
+    if mesh is None:
+        return local_loader
+    return ShardedDataLoader(local_loader, mesh)
+
+
+def _log_dataset(
+    blended_dataset,
+    sampler,
+    split,
+    num_training_steps,
+    gradient_accumulation_steps,
+    micro_batch_size,
+    dp_world_size,
+) -> None:
+    log_rank_0(logging.INFO, f"{'-' * 25} {split.value} {'-' * 25}")
+    log_rank_0(logging.INFO, repr(blended_dataset))
+
+    if split == DatasetSplit.train and num_training_steps is not None:
+        total_samples_seen = (
+            num_training_steps * gradient_accumulation_steps * micro_batch_size * dp_world_size
+        )
+    else:
+        num_steps = -(-len(blended_dataset) // (micro_batch_size * dp_world_size))
+        total_samples_seen = num_steps * micro_batch_size * dp_world_size
+
+    log_rank_0(logging.INFO, "*" * 57)
+    log_rank_0(logging.INFO, f"total samples seen = {total_samples_seen}")
+    log_rank_0(
+        logging.INFO,
+        f"total epochs for the dataset mixture = {total_samples_seen / len(blended_dataset)}",
+    )
+    log_rank_0(logging.INFO, repr(sampler))
+    log_rank_0(logging.INFO, "-" * 57)

@@ -1,0 +1,161 @@
+"""Finetuning dataset base classes.
+
+Parity: reference `dolomite_engine/data/base.py:8-247` (`BaseDataset`, `BlendedDatasets`,
+`get_max_input_length`/`get_max_output_length`). Framework-neutral Python — no torch Dataset
+inheritance; a dataset is anything with __len__/__getitem__ returning
+{"input": [ids], "output": [ids]}.
+"""
+
+from __future__ import annotations
+
+from ..defaults import INPUT_FORMAT, OUTPUT_FORMAT
+from ..enums import DatasetSplit, Mode
+
+
+class BaseDataset:
+    def __init__(
+        self,
+        class_args: dict,
+        split: DatasetSplit,
+        mode: Mode,
+        tokenizer,
+        is_encoder_decoder: bool,
+        data_name: str,
+        input_format: str,
+        output_format: str,
+        max_input_tokens: int | None,
+        max_output_tokens: int | None,
+        num_virtual_tokens: int = 0,
+    ) -> None:
+        self.split = split
+        self.mode = mode
+        self.class_args = class_args
+        self.tokenizer = tokenizer
+        self.is_encoder_decoder = is_encoder_decoder
+        self.num_virtual_tokens = num_virtual_tokens or 0
+        self.data_name = data_name
+        self.input_format = input_format
+        self.output_format = output_format
+
+        self.do_format_input = self.input_format != INPUT_FORMAT
+        self.do_format_output = self.output_format != OUTPUT_FORMAT
+
+        self.max_input_tokens = get_max_input_length(
+            max_input_tokens, self.num_virtual_tokens, is_encoder_decoder
+        )
+        self.max_output_tokens = get_max_output_length(
+            max_output_tokens, self.num_virtual_tokens, is_encoder_decoder
+        )
+
+        self.examples: list[dict] = []
+
+    def construct_input_from_format(self, input: str) -> str:
+        if self.do_format_input:
+            return self.input_format.replace(INPUT_FORMAT, input, 1)
+        return input
+
+    def construct_output_from_format(self, output: str) -> str:
+        if self.do_format_output:
+            return self.output_format.replace(OUTPUT_FORMAT, output, 1)
+        return output
+
+    def get_input_output_token_ids(self, input: str, output: str | None) -> dict:
+        eos_token_id: int = self.tokenizer.eos_token_id
+
+        input_ids: list[int] = self.tokenizer(input, add_special_tokens=False)["input_ids"]
+
+        if self.is_encoder_decoder:
+            if self.max_input_tokens is not None:
+                input_ids = input_ids[: self.max_input_tokens - 1]
+            input_ids.append(eos_token_id)
+        elif self.max_input_tokens is not None:
+            input_ids = input_ids[: self.max_input_tokens]
+
+        if self.mode == Mode.training:
+            output_ids: list[int] = self.tokenizer(output, add_special_tokens=False)["input_ids"]
+            if self.max_output_tokens is not None:
+                output_ids = output_ids[: self.max_output_tokens - 1]
+            output_ids.append(eos_token_id)
+
+            if not self.is_encoder_decoder:
+                input_ids = input_ids + output_ids
+
+            return {"input": input_ids, "output": output_ids}
+        return {"input": input_ids}
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        return
+
+    def __getitem__(self, index: int) -> dict:
+        return self.examples[index]
+
+    def __len__(self) -> int:
+        return len(self.examples)
+
+
+class BlendedDatasets:
+    """Concatenation of datasets (reference `data/base.py:136-198`)."""
+
+    def __init__(self, datasets: list[BaseDataset], split: DatasetSplit) -> None:
+        self.split = split
+        self.datasets = datasets
+        self.num_examples = sum(self.get_num_examples_in_each_dataset())
+
+        self.indexing_array: list[tuple[int, int]] = []
+        for dataset_index, n in enumerate(self.get_num_examples_in_each_dataset()):
+            for example_id in range(n):
+                self.indexing_array.append((dataset_index, example_id))
+
+    def get_num_datasets(self) -> int:
+        return len(self.datasets)
+
+    def get_num_examples_in_each_dataset(self) -> list[int]:
+        return [len(dataset) for dataset in self.datasets]
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        return
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def __getitem__(self, index: int) -> dict:
+        dataset_index, example_index = self.indexing_array[index]
+        return self.datasets[dataset_index][example_index]
+
+    def __repr__(self) -> str:
+        x = f"number of datasets = {self.get_num_datasets()}\n"
+        x += f"total examples in the entire dataset mixture = {len(self)}"
+        for dataset in self.datasets:
+            x += (
+                f"\nexamples in {dataset.__class__.__name__} ({dataset.data_name}) = "
+                f"{len(dataset)}"
+            )
+        return x
+
+
+def get_max_input_length(
+    max_input_tokens_specified: int | None, num_virtual_tokens: int, is_encoder_decoder: bool
+) -> int | None:
+    if max_input_tokens_specified is None:
+        return None
+    max_input_tokens = max_input_tokens_specified - num_virtual_tokens
+    if is_encoder_decoder:
+        max_input_tokens -= 1
+    return max_input_tokens
+
+
+def get_max_output_length(
+    max_output_tokens_specified: int | None, num_virtual_tokens: int, is_encoder_decoder: bool
+) -> int | None:
+    if max_output_tokens_specified is None:
+        return None
+    max_output_tokens = max_output_tokens_specified - 1
+    if is_encoder_decoder:
+        max_output_tokens -= num_virtual_tokens
+    return max_output_tokens

@@ -1,0 +1,93 @@
+"""Dataloaders: resumable host-side batcher + global-array feeder.
+
+Parity: reference `dolomite_engine/data/dataloader.py:12-104`:
+  - `ResumableDataLoader` (dataset+sampler state_dict) -> same here, minus torch.
+  - `DispatchingDataLoader` (node-rank0 loads batch x node_size, NCCL-broadcasts tensors, ranks
+    slice their shard) -> replaced by `ShardedDataLoader`: each HOST loads only its shard and
+    `jax.make_array_from_process_local_data` assembles the global sharded array — zero broadcast
+    traffic (the data never leaves the host that will feed those devices), which is strictly
+    better than dispatch-then-slice.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import jax
+import numpy as np
+
+
+class ResumableDataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        sampler,
+        collate_fn: Callable | None = None,
+        drop_last: bool = False,
+    ) -> None:
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.collate_fn = collate_fn
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator:
+        batch = []
+        for idx in self.sampler:
+            batch.append(self.dataset[idx])
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch) if self.collate_fn else batch
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch) if self.collate_fn else batch
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def state_dict(self) -> dict:
+        return {
+            "dataset": self.dataset.state_dict(),
+            "sampler": self.sampler.state_dict() if self.sampler is not None else {},
+        }
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        self.dataset.load_state_dict(state_dict.get("dataset"))
+        if self.sampler is not None:
+            self.sampler.load_state_dict(state_dict.get("sampler"))
+
+
+class ShardedDataLoader:
+    """Wraps a per-host dataloader; yields GLOBAL jax.Arrays sharded over the batch axes.
+
+    Each host's loader yields its local [local_batch, ...] numpy batch;
+    `make_array_from_process_local_data` forms the global array without any cross-host traffic.
+    """
+
+    def __init__(self, local_loader, mesh, batch_axes: tuple[str, ...] = ("dp", "fsdp")) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.local_loader = local_loader
+        self.mesh = mesh
+        self.sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
+
+    def __iter__(self) -> Iterator:
+        for batch in self.local_loader:
+            yield {
+                k: (
+                    jax.make_array_from_process_local_data(self.sharding, np.asarray(v))
+                    if v is not None
+                    else None
+                )
+                for k, v in batch.items()
+            }
+
+    def __len__(self) -> int:
+        return len(self.local_loader)
+
+    def state_dict(self) -> dict:
+        return self.local_loader.state_dict()
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        self.local_loader.load_state_dict(state_dict)

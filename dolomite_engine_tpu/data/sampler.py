@@ -1,0 +1,158 @@
+"""Proportional blended sampler with deterministic resume.
+
+Parity: reference `dolomite_engine/data/sampler.py:12-143` (`BlendedDistributedSampler`):
+per-subset shuffled over/undersampling to hit the requested sampling ratios, global permutation,
+pad-or-drop to a multiple of num_replicas, rank-strided subsample, epoch auto-increment, and
+resumability by replay-to-index. RNG is numpy (torch.Generator in the reference); determinism is
+per-framework, the *shape* of the algorithm is identical.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator
+
+import numpy as np
+
+from ..enums import DatasetSplit
+from .base import BlendedDatasets
+
+
+class BlendedDistributedSampler:
+    def __init__(
+        self,
+        dataset: BlendedDatasets,
+        data_sampling_ratios: list[int],
+        num_replicas: int,
+        rank: int,
+        ignore_sampling_proportion_for_validation: bool = True,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ) -> None:
+        assert 0 <= rank < num_replicas
+        self.dataset = dataset
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        self.num_examples_in_each_dataset = dataset.get_num_examples_in_each_dataset()
+        self.num_datasets = dataset.get_num_datasets()
+
+        if self.dataset.split == DatasetSplit.val and ignore_sampling_proportion_for_validation:
+            self.num_samples_by_dataset = self.num_examples_in_each_dataset
+        else:
+            self.num_samples_by_dataset = _get_num_samples_by_dataset(
+                data_sampling_ratios, len(dataset)
+            )
+
+        total = sum(self.num_samples_by_dataset)
+        if self.drop_last and total % self.num_replicas != 0:
+            self.num_samples = total // self.num_replicas
+        else:
+            self.num_samples = math.ceil(total / self.num_replicas)
+        self.total_size = self.num_samples * self.num_replicas
+
+        self.start_indices = np.cumsum([0] + self.num_examples_in_each_dataset[:-1]).tolist()
+        self.index = 0  # resumption cursor
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _rng(self) -> np.random.Generator:
+        return np.random.default_rng(self.seed + self.epoch)
+
+    def _get_indices_in_data_subset(
+        self, num_samples_in_subset: int, subset_size: int, rng: np.random.Generator
+    ) -> np.ndarray:
+        if num_samples_in_subset < subset_size:
+            if self.shuffle:
+                return rng.permutation(subset_size)[:num_samples_in_subset]
+            return np.arange(num_samples_in_subset)
+
+        num_concats = num_samples_in_subset // subset_size
+        padding = num_samples_in_subset - num_concats * subset_size
+        sampler = np.tile(np.arange(subset_size), num_concats)
+        if padding > 0:
+            if self.shuffle:
+                pad = rng.permutation(subset_size)[:padding]
+            else:
+                pad = np.arange(padding)
+            sampler = np.concatenate([sampler, pad])
+        return sampler
+
+    def __iter__(self) -> Iterator[int]:
+        rng = self._rng()
+
+        indices = []
+        for dataset_index in range(self.num_datasets):
+            sub = self._get_indices_in_data_subset(
+                self.num_samples_by_dataset[dataset_index],
+                self.num_examples_in_each_dataset[dataset_index],
+                rng,
+            )
+            indices.extend((sub + self.start_indices[dataset_index]).tolist())
+
+        if self.shuffle:
+            perm_rng = self._rng()
+            indices = np.asarray(indices)[perm_rng.permutation(len(indices))].tolist()
+
+        if self.drop_last:
+            indices = indices[: self.total_size]
+        else:
+            padding_size = self.total_size - len(indices)
+            if padding_size > 0:
+                if padding_size <= len(indices):
+                    indices += indices[:padding_size]
+                else:
+                    indices += (indices * math.ceil(padding_size / len(indices)))[:padding_size]
+
+        assert len(indices) == self.total_size
+
+        indices = indices[self.rank : self.total_size : self.num_replicas]
+        assert len(indices) == self.num_samples
+
+        self.index = 0
+        for i in indices:
+            self.index += 1
+            yield i
+
+        self.set_epoch(self.epoch + 1)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "index": self.index}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        self.set_epoch(state_dict["epoch"])
+        if state_dict["index"] == 0:
+            return
+        for _ in self:
+            if self.index == state_dict["index"]:
+                break
+
+    def __repr__(self) -> str:
+        x = ""
+        for i, dataset in enumerate(self.dataset.datasets):
+            name = f"{dataset.__class__.__name__} ({dataset.data_name})"
+            x += (
+                f"number of samples of {name} in 1 epoch of the entire dataset = "
+                f"{self.num_samples_by_dataset[i]}\n"
+            )
+            x += (
+                f"number of epochs of {name} in 1 epoch of the entire dataset = "
+                f"{self.num_samples_by_dataset[i] / max(len(dataset), 1)}\n\n"
+            )
+        return x.rstrip()
+
+
+def _get_num_samples_by_dataset(data_sampling_ratio: list[int], total_examples: int) -> list[int]:
+    ratios = np.asarray(data_sampling_ratio, dtype=np.float64)
+    num = (ratios / ratios.sum() * total_examples).astype(np.int64)
+    num[-1] = total_examples - num[:-1].sum()
+    return num.tolist()

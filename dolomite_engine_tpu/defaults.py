@@ -1,0 +1,4 @@
+"""Parity: reference `dolomite_engine/defaults.py`."""
+
+INPUT_FORMAT = "__input__"
+OUTPUT_FORMAT = "__output__"

@@ -1,0 +1,104 @@
+"""Distributed wrapping: sharded train-state creation (the GSPMD "FSDP/ZeRO/TP wrap").
+
+Parity: reference `dolomite_engine/distributed/__init__.py:47-236`
+(`wrap_model_for_distributed_training`): chooses FSDP1/FSDP2/DeepSpeed engines, sharding
+strategies per ZeRO stage, mixed-precision policies, gradient checkpointing wrap, torch.compile.
+Here all of that collapses into: build the mesh, derive NamedShardings for every TrainState leaf
+from the model's logical axis metadata (+ZeRO-stage rules), and jit-initialize the state directly
+into its shards (no full replica ever materializes — the reference needs meta-device + FSDP
+param_init_fn for the same effect). Mixed precision = module compute dtype (params stay fp32,
+matching the reference's `param_dtype=fp32` policies at `distributed/__init__.py:34-44`).
+DeepSpeed/ZeRO++ options are accepted upstream and coerced (see arguments.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..model_wrapper import ModelWrapper
+from ..parallel.mesh import MeshManager
+from ..parallel.sharding import logical_to_mesh_sharding
+from ..train_utils import TrainState
+
+
+def build_mesh_from_args(args) -> Mesh:
+    dist = args.distributed_args
+    MeshManager(
+        tensor_parallel_size=dist.tensor_parallel_size,
+        sequence_parallel_size=dist.context_parallel_size,
+        expert_parallel_size=dist.expert_parallel_size,
+        data_parallel_replication_world_size=dist.zero_topology.data_parallel_replication_world_size,
+        data_parallel_sharding_world_size=dist.zero_topology.data_parallel_sharding_world_size,
+    )
+    return MeshManager.get_mesh()
+
+
+def get_state_shardings(
+    model: ModelWrapper,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+) -> tuple[Any, Any]:
+    """(abstract_state, sharding tree) for the full TrainState.
+
+    Params follow the param rules; optimizer state follows the optimizer rules (ZeRO-1/2 shard
+    opt state while params stay replicated); scalars replicate.
+    """
+    import jax.numpy as jnp
+
+    def _abstract_init():
+        variables = model.model.init(jax.random.PRNGKey(0), **model.get_dummy_inputs())
+        params = variables["params"]
+        opt_state = optimizer.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+    abstract_state = jax.eval_shape(_abstract_init)
+    logical_specs = nn.get_partition_spec(abstract_state)
+
+    param_shardings = logical_to_mesh_sharding(
+        logical_specs.params, mesh, model.sharding_rules(for_optimizer=False)
+    )
+    opt_shardings = logical_to_mesh_sharding(
+        logical_specs.opt_state, mesh, model.sharding_rules(for_optimizer=True)
+    )
+    shardings = TrainState(
+        step=NamedSharding(mesh, PartitionSpec()),
+        params=param_shardings,
+        opt_state=opt_shardings,
+    )
+    return abstract_state, shardings
+
+
+def create_sharded_train_state(
+    model: ModelWrapper,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+) -> tuple[TrainState, Any]:
+    """Initialize the TrainState sharded-from-birth; returns (state, shardings)."""
+    import jax.numpy as jnp
+
+    _, shardings = get_state_shardings(model, optimizer, mesh)
+
+    def _init():
+        variables = model.model.init(rng, **model.get_dummy_inputs())
+        params = variables["params"]
+        opt_state = optimizer.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+    with mesh:
+        state = jax.jit(_init, out_shardings=shardings)()
+    return state, shardings
+
+
+def wrap_model_for_distributed_training(args, model: ModelWrapper, optimizer, rng=None):
+    """Build mesh + sharded state (reference entrypoint name kept)."""
+    mesh = build_mesh_from_args(args)
+    if rng is None:
+        rng = jax.random.PRNGKey(args.random_args.seed)
+    state, shardings = create_sharded_train_state(model, optimizer, mesh, rng)
+    return mesh, state, shardings

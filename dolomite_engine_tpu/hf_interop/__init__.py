@@ -1,0 +1,1 @@
+from .weights import interleave_qkv, params_to_state_dict, split_qkv, state_dict_to_params

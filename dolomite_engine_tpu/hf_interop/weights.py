@@ -1,0 +1,215 @@
+"""Weight-layout conversion: flax param tree <-> HF "dolomite"-format state dicts.
+
+Parity: the reference stores fused c_attn weights in per-head-interleaved layouts that differ by
+head type (`hf_models/modeling_utils/attention/utils.py:18-118`):
+  - mha: per head [q_i | k_i | v_i]
+  - gqa: per kv-group [q_group | k_i | v_i]
+  - mqa: flat [Q | k | v]
+and fused GLU c_fc as [up ; gate] (`gpt_dolomite/mlp.py:53-58`). This framework's internal layout
+is always flat [Q | K | V] on the flax-kernel OUTPUT axis (kernel is [in, out]; torch Linear
+weight is [out, in] — transposed). These converters produce/consume the reference's exact
+safetensors layout so checkpoints interop bit-for-bit with the GPU engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from flax import linen as nn
+
+from ..models.config import CommonConfig
+from ..models.enums import AttentionHeadType, PositionEmbeddingType
+
+
+# ---------------------------------------------------------------- qkv interleave (numpy)
+def interleave_qkv(
+    q: np.ndarray, k: np.ndarray, v: np.ndarray, config: CommonConfig
+) -> np.ndarray:
+    """[*, in]-layout (torch) q/k/v -> interleaved fused tensor, per reference layout."""
+    head_type = AttentionHeadType(config.attention_head_type)
+    head_dim = config.head_dim
+
+    if head_type == AttentionHeadType.mha:
+        parts = []
+        for i in range(config.n_head):
+            s = i * head_dim
+            parts += [q[s : s + head_dim], k[s : s + head_dim], v[s : s + head_dim]]
+        return np.concatenate(parts)
+    if head_type == AttentionHeadType.gqa:
+        g = config.n_head // config.num_key_value_heads
+        parts = []
+        for i in range(config.num_key_value_heads):
+            parts.append(q[i * g * head_dim : (i + 1) * g * head_dim])
+            parts.append(k[i * head_dim : (i + 1) * head_dim])
+            parts.append(v[i * head_dim : (i + 1) * head_dim])
+        return np.concatenate(parts)
+    return np.concatenate([q, k, v])  # mqa
+
+
+def split_qkv(fused: np.ndarray, config: CommonConfig) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse of interleave_qkv (reference split_query_key_value_tensor_for_*)."""
+    head_type = AttentionHeadType(config.attention_head_type)
+    head_dim = config.head_dim
+    tail = fused.shape[1:]
+
+    if head_type == AttentionHeadType.mha:
+        w = fused.reshape(config.n_head, 3, head_dim, *tail)
+        q = w[:, 0].reshape(-1, *tail)
+        k = w[:, 1].reshape(-1, *tail)
+        v = w[:, 2].reshape(-1, *tail)
+        return q, k, v
+    if head_type == AttentionHeadType.gqa:
+        g = config.n_head // config.num_key_value_heads
+        w = fused.reshape(config.num_key_value_heads, g + 2, head_dim, *tail)
+        q = w[:, :g].reshape(-1, *tail)
+        k = w[:, g].reshape(-1, *tail)
+        v = w[:, g + 1].reshape(-1, *tail)
+        return q, k, v
+    nq = config.n_head * head_dim
+    return fused[:nq], fused[nq : nq + head_dim], fused[nq + head_dim :]
+
+
+# ---------------------------------------------------------------- tree <-> flat dict
+def _unbox(tree: Any) -> Any:
+    return jax.tree.map(
+        lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
+def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndarray]:
+    """flax params (possibly boxed/sharded) -> reference-layout torch-style state dict."""
+    params = _unbox(params)
+    params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+
+    sd: dict[str, np.ndarray] = {}
+    t = params["transformer"]
+
+    sd["transformer.wte.weight"] = t["wte"]["embedding"]
+    if PositionEmbeddingType(config.position_embedding_type) == PositionEmbeddingType.learned_absolute:
+        sd["transformer.wpe.weight"] = t["wpe"]["embedding"]
+
+    for i in range(config.n_layer):
+        h = t[f"h_{i}"]
+        p = f"transformer.h.{i}."
+
+        _norm_to_sd(sd, p + "ln_1.", h["ln_1"])
+        _norm_to_sd(sd, p + "ln_2.", h["ln_2"])
+
+        # attention: flax kernel [in, out] -> torch [out, in], then interleave
+        ck = np.ascontiguousarray(h["attn"]["c_attn"]["kernel"].T)
+        nq = config.n_head * config.head_dim
+        nkv = config.num_key_value_heads * config.head_dim
+        q, k, v = ck[:nq], ck[nq : nq + nkv], ck[nq + nkv :]
+        sd[p + "attn.c_attn.weight"] = interleave_qkv(q, k, v, config)
+        if "bias" in h["attn"]["c_attn"]:
+            b = h["attn"]["c_attn"]["bias"]
+            qb, kb, vb = b[:nq], b[nq : nq + nkv], b[nq + nkv :]
+            sd[p + "attn.c_attn.bias"] = interleave_qkv(qb, kb, vb, config)
+
+        sd[p + "attn.c_proj.weight"] = np.ascontiguousarray(h["attn"]["c_proj"]["kernel"].T)
+        if "bias" in h["attn"]["c_proj"]:
+            sd[p + "attn.c_proj.bias"] = h["attn"]["c_proj"]["bias"]
+
+        sd[p + "mlp.c_fc.weight"] = np.ascontiguousarray(h["mlp"]["c_fc"]["kernel"].T)
+        if "bias" in h["mlp"]["c_fc"]:
+            sd[p + "mlp.c_fc.bias"] = h["mlp"]["c_fc"]["bias"]
+        sd[p + "mlp.c_proj.weight"] = np.ascontiguousarray(h["mlp"]["c_proj"]["kernel"].T)
+        if "bias" in h["mlp"]["c_proj"]:
+            sd[p + "mlp.c_proj.bias"] = h["mlp"]["c_proj"]["bias"]
+
+    _norm_to_sd(sd, "transformer.ln_f.", t["ln_f"])
+
+    if not config.tie_word_embeddings:
+        sd["lm_head.weight"] = np.ascontiguousarray(params["lm_head"]["kernel"].T)
+
+    return sd
+
+
+def _norm_to_sd(sd: dict, prefix: str, norm_params: dict) -> None:
+    sd[prefix + "weight"] = norm_params["weight"]
+    if "bias" in norm_params:
+        sd[prefix + "bias"] = norm_params["bias"]
+
+
+def state_dict_to_params(
+    config: CommonConfig,
+    get_tensor,
+    mesh=None,
+    shardings: Any | None = None,
+    abstract: Any | None = None,
+) -> Any:
+    """Reference-layout state dict -> flax params tree.
+
+    `get_tensor`: callable name -> np.ndarray (or a SafeTensorsWeightsManager).
+    When `shardings` is given, leaves are device_put with their NamedSharding (per-shard
+    placement; combined with orbax/safetensors lazy slices this avoids full-host copies for
+    TP loading — reference `modeling_utils_TP/TP.py:11-43`).
+    """
+    if hasattr(get_tensor, "get_tensor"):
+        manager = get_tensor
+        get_tensor = manager.get_tensor
+
+    params: dict = {"transformer": {}}
+    t = params["transformer"]
+
+    t["wte"] = {"embedding": get_tensor("transformer.wte.weight")}
+    if PositionEmbeddingType(config.position_embedding_type) == PositionEmbeddingType.learned_absolute:
+        t["wpe"] = {"embedding": get_tensor("transformer.wpe.weight")}
+
+    for i in range(config.n_layer):
+        p = f"transformer.h.{i}."
+        h: dict = {}
+        t[f"h_{i}"] = h
+
+        h["ln_1"] = _norm_from_sd(get_tensor, p + "ln_1.", config)
+        h["ln_2"] = _norm_from_sd(get_tensor, p + "ln_2.", config)
+
+        q, k, v = split_qkv(get_tensor(p + "attn.c_attn.weight"), config)
+        kernel = np.ascontiguousarray(np.concatenate([q, k, v]).T)
+        h["attn"] = {"c_attn": {"kernel": kernel}, "c_proj": {}}
+        if config.add_bias:
+            qb, kb, vb = split_qkv(get_tensor(p + "attn.c_attn.bias"), config)
+            h["attn"]["c_attn"]["bias"] = np.concatenate([qb, kb, vb])
+
+        h["attn"]["c_proj"]["kernel"] = np.ascontiguousarray(
+            get_tensor(p + "attn.c_proj.weight").T
+        )
+        if config.add_bias:
+            h["attn"]["c_proj"]["bias"] = get_tensor(p + "attn.c_proj.bias")
+
+        h["mlp"] = {
+            "c_fc": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_fc.weight").T)},
+            "c_proj": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_proj.weight").T)},
+        }
+        if config.add_bias:
+            h["mlp"]["c_fc"]["bias"] = get_tensor(p + "mlp.c_fc.bias")
+            h["mlp"]["c_proj"]["bias"] = get_tensor(p + "mlp.c_proj.bias")
+
+    t["ln_f"] = _norm_from_sd(get_tensor, "transformer.ln_f.", config)
+
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": np.ascontiguousarray(get_tensor("lm_head.weight").T)}
+
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+
+    if shardings is not None:
+        unboxed_shardings = jax.tree.map(
+            lambda s: s, shardings, is_leaf=lambda x: hasattr(x, "spec")
+        )
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            params,
+            unboxed_shardings,
+        )
+    return params
+
+
+def _norm_from_sd(get_tensor, prefix: str, config: CommonConfig) -> dict:
+    out = {"weight": get_tensor(prefix + "weight")}
+    if config.normalization_function == "layernorm":
+        out["bias"] = get_tensor(prefix + "bias")
+    return out

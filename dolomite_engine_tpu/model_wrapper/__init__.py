@@ -1,0 +1,12 @@
+from .base import ModelWrapper
+from .pretraining import ModelWrapperForFinetuning, ModelWrapperForPretraining, get_model
+
+
+def log_model(model: ModelWrapper) -> None:
+    """Parity: reference `model_wrapper/__init__.py:56-66` logs the model tree + param count."""
+    import logging
+
+    from ..utils import log_rank_0
+
+    log_rank_0(logging.INFO, f"model = {model.model}")
+    log_rank_0(logging.INFO, f"num parameters = {model.num_parameters():,}")

@@ -1,0 +1,196 @@
+"""ModelWrapper: owns config/tokenizer/flax-module construction + param initialization.
+
+Parity: reference `dolomite_engine/model_wrapper/base.py:13-266` (`ModelWrapper`): resolves
+config from `model_name` (local HF dir / hub) or `pretrained_config` dict, builds tokenizer,
+validates padding-free prerequisites (reference lines 85-92 require custom model + flash-attn;
+here padding-free works with every attention implementation since segment-ids masking is native),
+efficient meta-device init (lines 210-230 — JAX equivalent is `jax.eval_shape` + sharded jit
+init, always on), NEFTune noisy-embedding override (lines 246-266 — implemented in the
+finetuning wrapper's loss), additional special tokens + embedding resize (lines 101-108).
+
+The reference swaps in a `_TP` model class when tp > 1 (base.py:78-83); under GSPMD there is one
+model class and TP is a sharding-rule choice, so no swap exists.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..enums import AttentionImplementation, Mode
+from ..models import config_from_dict, get_model_class
+from ..models.config import CommonConfig
+from ..parallel.sharding import LogicalRules, get_logical_axis_rules, logical_to_mesh_sharding
+from ..utils import log_rank_0, string_to_dtype
+
+
+class ModelWrapper:
+    def __init__(
+        self,
+        mode: Mode,
+        model_name: str | None = None,
+        pretrained_config: dict | None = None,
+        model_class: str = "AutoModelForCausalLM",
+        dtype: str = "fp32",
+        efficient_initialization: bool = False,
+        attention_implementation: AttentionImplementation | None = None,
+        use_padding_free_transformer: bool = False,
+        tensor_parallel_word_embeddings: bool = False,
+        sequence_parallel: bool = False,
+        zero_stage: int = 3,
+        gradient_checkpointing_args: dict | None = None,
+        tokenizer_name: str | None = None,
+        additional_special_tokens: list[str] | None = None,
+        neft_alpha: float | None = None,
+        trust_remote_code: bool = False,
+    ) -> None:
+        self.mode = mode
+        self.model_name = model_name
+        self.dtype = string_to_dtype(dtype)
+        self.use_padding_free_transformer = use_padding_free_transformer
+        self.tensor_parallel_word_embeddings = tensor_parallel_word_embeddings
+        self.sequence_parallel = sequence_parallel
+        self.zero_stage = zero_stage
+        self.neft_alpha = neft_alpha
+
+        if attention_implementation is None:
+            attention_implementation = AttentionImplementation.sdpa
+        self.attention_implementation = attention_implementation
+
+        self._setup_config(model_name, pretrained_config)
+        self._setup_tokenizer(tokenizer_name, additional_special_tokens)
+
+        checkpoint_every = 0
+        if gradient_checkpointing_args:
+            checkpoint_every = gradient_checkpointing_args.get(
+                "checkpoint_every", gradient_checkpointing_args.get("block_frequency", 1)
+            )
+        self.checkpoint_every = checkpoint_every
+
+        self._setup_model()
+
+    # ------------------------------------------------------------------ setup
+    def _setup_config(self, model_name: str | None, pretrained_config: dict | None) -> None:
+        if model_name is None:
+            assert pretrained_config is not None
+            self.config = config_from_dict(pretrained_config)
+        else:
+            import json
+            import os
+
+            config_path = os.path.join(model_name, "config.json")
+            if os.path.isfile(config_path):
+                with open(config_path) as f:
+                    self.config = config_from_dict(json.load(f))
+            else:
+                raise ValueError(
+                    f"model_name '{model_name}' is not a local checkpoint directory; "
+                    "import HF hub models with hf_interop.import_from_huggingface first"
+                )
+        self.model_type = self.config.model_type
+
+    def _setup_tokenizer(
+        self, tokenizer_name: str | None, additional_special_tokens: list[str] | None
+    ) -> None:
+        self.tokenizer = None
+        name = tokenizer_name or self.model_name
+        if name is not None:
+            try:
+                from transformers import AutoTokenizer
+
+                self.tokenizer = AutoTokenizer.from_pretrained(name)
+                if additional_special_tokens:
+                    n_added = self.tokenizer.add_special_tokens(
+                        {"additional_special_tokens": additional_special_tokens}
+                    )
+                    if n_added > 0 and self.tokenizer.vocab_size > self.config.vocab_size:
+                        # resize_token_embeddings equivalent: bump config vocab (params are
+                        # created from config, so this resizes the embedding at init)
+                        self.config.vocab_size = len(self.tokenizer)
+            except Exception as e:  # tokenizer is optional for pretraining on token bins
+                log_rank_0(logging.WARNING, f"could not load tokenizer '{name}': {e}")
+
+    def _setup_model(self) -> None:
+        model_cls = get_model_class(self.model_type)
+        self.model: nn.Module = model_cls(
+            config=self.config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            checkpoint_every=self.checkpoint_every,
+        )
+
+    # ------------------------------------------------------------------ params
+    def get_dummy_inputs(self) -> dict:
+        return {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+
+    def abstract_params(self):
+        """Shape/dtype tree without allocating (reference's meta-device init, base.py:210-230)."""
+        return jax.eval_shape(
+            lambda: self.model.init(jax.random.PRNGKey(0), **self.get_dummy_inputs())
+        )["params"]
+
+    def logical_specs(self):
+        variables = self.abstract_params()
+        return nn.get_partition_spec({"params": variables})["params"]
+
+    def sharding_rules(self, for_optimizer: bool = False) -> LogicalRules:
+        return get_logical_axis_rules(
+            stage=self.zero_stage,
+            tensor_parallel_word_embeddings=self.tensor_parallel_word_embeddings,
+            sequence_parallel=self.sequence_parallel,
+            for_optimizer=for_optimizer,
+        )
+
+    def param_shardings(self, mesh, for_optimizer: bool = False):
+        return logical_to_mesh_sharding(
+            self.logical_specs(), mesh, self.sharding_rules(for_optimizer)
+        )
+
+    def init_params(self, rng: jax.Array, mesh) -> Any:
+        """Sharded-from-birth init: jit with out_shardings so no host copy of the full model
+        ever exists (the TPU equivalent of meta-device + per-rank materialization)."""
+        shardings = self.param_shardings(mesh)
+
+        def _init():
+            return self.model.init(rng, **self.get_dummy_inputs())["params"]
+
+        with mesh:
+            return jax.jit(_init, out_shardings=shardings)()
+
+    # ------------------------------------------------------------------ io
+    def save_pretrained(self, save_path: str, params: Any | None = None) -> None:
+        """Write HF-layout checkpoint dir: config.json + model.safetensors (+ tokenizer)."""
+        from ..hf_interop.weights import params_to_state_dict
+        from ..utils.safetensors import SafeTensorsWeightsManager
+
+        self.config.save_pretrained(save_path)
+        if params is not None:
+            state_dict = params_to_state_dict(self.config, params)
+            SafeTensorsWeightsManager.save_state_dict(state_dict, save_path)
+        if self.tokenizer is not None:
+            self.tokenizer.save_pretrained(save_path)
+
+    def load_pretrained_params(self, path: str, mesh) -> Any:
+        from ..hf_interop.weights import state_dict_to_params
+        from ..utils.safetensors import SafeTensorsWeightsManager
+
+        manager = SafeTensorsWeightsManager(path)
+        return state_dict_to_params(self.config, manager, mesh, self.param_shardings(mesh))
+
+    # ------------------------------------------------------------------ forward
+    def __call__(self, params, batch: dict, rngs: dict | None = None, train: bool = False):
+        return self.model.apply(
+            {"params": params},
+            deterministic=not train,
+            rngs=rngs,
+            **batch,
+        )
+
+    def num_parameters(self) -> int:
+        return sum(
+            int(jnp.prod(jnp.asarray(x.shape))) for x in jax.tree.leaves(self.abstract_params())
+        )

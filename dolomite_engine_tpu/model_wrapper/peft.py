@@ -1,0 +1,47 @@
+"""PEFT wrapper (LoRA / prompt tuning).
+
+Parity: reference `dolomite_engine/model_wrapper/peft.py:9-45` wraps with HF peft's
+LoraConfig/PromptTuningConfig. The JAX implementation lives in `peft/` (LoRA adapters as a
+separate param collection + optax masking; prompt tuning as trainable virtual-token embeddings).
+"""
+
+from __future__ import annotations
+
+from ..enums import TuningMethod
+from .pretraining import ModelWrapperForFinetuning
+
+
+class ModelWrapperForPEFT(ModelWrapperForFinetuning):
+    def __init__(self, *args, tuning_args=None, **kwargs) -> None:
+        assert tuning_args is not None
+        self.tuning_method = tuning_args.tuning_method
+        self.lora_args = tuning_args.lora_args
+        self.prompt_tuning_args = tuning_args.prompt_tuning_args
+        super().__init__(*args, **kwargs)
+
+    def _setup_model(self) -> None:
+        super()._setup_model()
+        if self.tuning_method == TuningMethod.lora:
+            from ..peft.lora import LoRACausalLM
+
+            self.model = LoRACausalLM(
+                base_model=self.model,
+                rank=self.lora_args.lora_rank,
+                alpha=self.lora_args.lora_alpha,
+                dropout=self.lora_args.lora_dropout,
+            )
+        elif self.tuning_method == TuningMethod.prompt_tuning:
+            from ..peft.prompt_tuning import PromptTuningCausalLM
+
+            self.model = PromptTuningCausalLM(
+                base_model=self.model,
+                num_virtual_tokens=self.prompt_tuning_args.num_virtual_tokens,
+                init_text=self.prompt_tuning_args.prompt_tuning_init_text,
+                tokenizer=self.tokenizer,
+            )
+
+    def trainable_mask(self, params):
+        """optax mask: True = trainable. Base weights frozen for PEFT."""
+        from ..peft import peft_trainable_mask
+
+        return peft_trainable_mask(params)

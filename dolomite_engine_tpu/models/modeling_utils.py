@@ -1,0 +1,416 @@
+"""Shared flax modules: parameterized linear/embedding, norms, attention, MLP, block.
+
+Parity map (reference -> here):
+  - `hf_models/modeling_utils/linear.py:5-25` / `embedding.py:5-44` (ParameterizedLinear/
+    Embedding with stored init std for deferred meta-device init): here std feeds the flax init
+    fn directly; "deferred init" is native to JAX (`jax.eval_shape` + sharded `jit` init).
+  - `hf_models/modeling_utils/attention/base.py:30-169`: fused c_attn over MHA/MQA/GQA. The
+    reference uses per-head interleaved fused layouts (different per head type, see
+    `_prepare_qkv_for_forward_*`); here the fused projection is always laid out flat
+    [Q (Hq*D) | K (Hkv*D) | V (Hkv*D)] — one layout for all head types, contiguous for TP
+    sharding over the head axis. HF-interop converts between layouts
+    (`hf_interop/weights.py`).
+  - softmax scale: `attention_multiplier` if set else head_dim**-0.5 if `scale_attn_weights`
+    (reference `attention/sdpa.py` / `base.py`).
+  - µP (`m_emb`/`m_width`/`m_residual`, init std rules `mlp.py:26-41`, `attention/base.py:72-86`):
+    c_attn/c_fc std = initializer_range (mup: /sqrt(m_width)); c_proj std =
+    initializer_range/sqrt(2*n_layer) (mup: additionally /sqrt(m_width)).
+
+Sharding: params carry logical axis names via `nn.with_partitioning`; activations are constrained
+with `nn.with_logical_constraint` (rules in `parallel/sharding.py`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..enums import AttentionImplementation
+from ..ops.activations import get_activation_function, is_glu
+from ..ops.attention import attention as attention_op
+from ..ops.normalization import check_normalization_function, layernorm, rmsnorm
+from ..ops.rope import RoPEParams, apply_rotary_pos_emb, get_cos_sin
+from .config import CommonConfig
+from .enums import InitMethod, PositionEmbeddingType
+
+Dtype = Any
+
+KVCache = dict[str, jax.Array]  # {"k": [B, L, Hkv, D], "v": [B, L, Hkv, D]}
+
+
+def _normal_init(std: float) -> Callable:
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.normal(key, shape, dtype) * std
+
+    return init
+
+
+class ParameterizedLinear(nn.Module):
+    features: int
+    use_bias: bool = True
+    std: float = 0.02
+    kernel_axes: tuple[str | None, ...] = (None, None)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(_normal_init(self.std), self.kernel_axes),
+            (x.shape[-1], self.features),
+            jnp.float32,
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(nn.initializers.zeros_init(), (self.kernel_axes[-1],)),
+                (self.features,),
+                jnp.float32,
+            )
+            y = y + bias.astype(self.dtype)
+
+        # LoRA adapters (peft/lora.py): active only inside a lora_scope on targeted modules
+        from ..peft.lora import get_active_lora
+
+        lora = get_active_lora(self.name)
+        if lora is not None:
+            lora_a = self.param(
+                "lora_a",
+                nn.with_partitioning(_normal_init(x.shape[-1] ** -0.5), (self.kernel_axes[0], None)),
+                (x.shape[-1], lora.rank),
+                jnp.float32,
+            )
+            lora_b = self.param(
+                "lora_b",
+                nn.with_partitioning(nn.initializers.zeros_init(), (None, self.kernel_axes[-1])),
+                (lora.rank, self.features),
+                jnp.float32,
+            )
+            h = x.astype(self.dtype)
+            if lora.dropout > 0.0 and not self.is_initializing():
+                try:
+                    rng = self.make_rng("dropout")
+                    keep = jax.random.bernoulli(rng, 1.0 - lora.dropout, h.shape)
+                    h = jnp.where(keep, h / (1.0 - lora.dropout), 0.0)
+                except Exception:
+                    pass  # deterministic eval: no dropout rng provided
+            delta = jnp.dot(jnp.dot(h, lora_a.astype(self.dtype)), lora_b.astype(self.dtype))
+            y = y + (lora.alpha / lora.rank) * delta
+        return y
+
+
+class ParameterizedEmbedding(nn.Module):
+    num_embeddings: int
+    features: int
+    std: float = 0.02
+    embedding_axes: tuple[str | None, ...] = ("vocab", "embed")
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        embedding = self.param(
+            "embedding",
+            nn.with_partitioning(_normal_init(self.std), self.embedding_axes),
+            (self.num_embeddings, self.features),
+            jnp.float32,
+        )
+        return jnp.take(embedding.astype(self.dtype), ids, axis=0)
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Tied LM head: x @ embedding.T (vocab-parallel when "vocab" -> tp)."""
+        embedding = self.get_variable("params", "embedding")
+        if hasattr(embedding, "unbox"):
+            embedding = embedding.unbox()
+        return jnp.dot(x.astype(self.dtype), embedding.astype(self.dtype).T)
+
+
+class Norm(nn.Module):
+    """layernorm / rmsnorm with fp32 accumulation (reference `modeling_utils/normalization/`)."""
+
+    normalization_function: str = "layernorm"
+    eps: float = 1e-5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        check_normalization_function(self.normalization_function)
+        dim = x.shape[-1]
+        weight = self.param(
+            "weight",
+            nn.with_partitioning(nn.initializers.ones_init(), (None,)),
+            (dim,),
+            jnp.float32,
+        )
+        if self.normalization_function == "rmsnorm":
+            return rmsnorm(x, weight, self.eps)
+        bias = self.param(
+            "bias",
+            nn.with_partitioning(nn.initializers.zeros_init(), (None,)),
+            (dim,),
+            jnp.float32,
+        )
+        return layernorm(x, weight, bias, self.eps)
+
+
+def get_norm(config: CommonConfig, dtype: Dtype, name: str | None = None) -> Norm:
+    """`name` must be None when called from a `setup()` body (linen auto-names attributes)."""
+    kwargs = {} if name is None else {"name": name}
+    return Norm(
+        normalization_function=config.normalization_function,
+        eps=config.layer_norm_epsilon,
+        dtype=dtype,
+        **kwargs,
+    )
+
+
+class Attention(nn.Module):
+    """Self-attention with fused QKV, RoPE/alibi, KV cache, all head types."""
+
+    config: CommonConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    causal: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None = None,
+        alibi_bias: jax.Array | None = None,
+        kv_cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        config = self.config
+        hidden_size = config.n_embd
+        num_heads = config.n_head
+        num_kv_heads = config.num_key_value_heads
+        head_dim = config.head_dim
+
+        init_method = InitMethod(config.init_method)
+        std = config.initializer_range
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_attn = ParameterizedLinear(
+            features=(num_heads + 2 * num_kv_heads) * head_dim,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "heads"),
+            dtype=self.dtype,
+            name="c_attn",
+        )
+
+        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_proj = ParameterizedLinear(
+            features=hidden_size,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("heads", "embed"),
+            dtype=self.dtype,
+            name="c_proj",
+        )
+
+        batch, seq = hidden_states.shape[:2]
+        qkv = c_attn(hidden_states)
+        qkv = nn.with_logical_constraint(qkv, ("act_batch", "act_seq", "act_heads"))
+
+        query, key, value = jnp.split(
+            qkv, [num_heads * head_dim, (num_heads + num_kv_heads) * head_dim], axis=-1
+        )
+        query = query.reshape(batch, seq, num_heads, head_dim)
+        key = key.reshape(batch, seq, num_kv_heads, head_dim)
+        value = value.reshape(batch, seq, num_kv_heads, head_dim)
+
+        if rope_cos_sin is not None:
+            cos, sin = rope_cos_sin
+            query = apply_rotary_pos_emb(query, cos, sin)
+            key = apply_rotary_pos_emb(key, cos, sin)
+
+        query_offset = 0
+        if kv_cache is not None:
+            # decode: write new K/V at cache_index, attend over the whole cache
+            assert cache_index is not None
+            k_cache = jax.lax.dynamic_update_slice(kv_cache["k"], key, (0, cache_index, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(kv_cache["v"], value, (0, cache_index, 0, 0))
+            kv_cache = {"k": k_cache, "v": v_cache}
+            key, value = k_cache, v_cache
+            query_offset = cache_index
+            # mask out not-yet-written cache positions
+            cache_len = k_cache.shape[1]
+            valid = jnp.arange(cache_len)[None, :] < (cache_index + seq)
+            attention_mask = (
+                valid.astype(jnp.int32)
+                if attention_mask is None
+                else attention_mask * valid.astype(attention_mask.dtype)
+            )
+
+        if config.attention_multiplier is not None:
+            softmax_scale = config.attention_multiplier
+        elif config.scale_attn_weights:
+            softmax_scale = head_dim**-0.5
+        else:
+            softmax_scale = 1.0
+
+        dropout_rng = None
+        attn_pdrop = 0.0 if deterministic else config.attn_pdrop
+        if attn_pdrop > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        out = attention_op(
+            query,
+            key,
+            value,
+            implementation=self.attention_implementation,
+            causal=self.causal,
+            softmax_scale=softmax_scale,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            alibi_bias=alibi_bias,
+            softmax_in_fp32=config.attention_softmax_in_fp32,
+            dropout=attn_pdrop,
+            dropout_rng=dropout_rng,
+            query_offset=query_offset,
+        )
+
+        out = out.reshape(batch, seq, num_heads * head_dim)
+        out = c_proj(out)
+        out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)
+        return out, kv_cache
+
+
+class MLP(nn.Module):
+    """Fused up+gate MLP (reference `gpt_dolomite/mlp.py:11-58`): c_fc emits 2*n_inner for GLU
+    activations laid out [up | gate], activation computes up * act(gate)."""
+
+    config: CommonConfig
+    dtype: Dtype = jnp.float32
+    intermediate_size: int | None = None
+
+    @nn.compact
+    def __call__(self, hidden_states: jax.Array, deterministic: bool = True) -> jax.Array:
+        config = self.config
+        intermediate = self.intermediate_size or config.n_inner
+        glu = is_glu(config.activation_function)
+
+        init_method = InitMethod(config.init_method)
+        std = config.initializer_range
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_fc = ParameterizedLinear(
+            features=2 * intermediate if glu else intermediate,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "mlp"),
+            dtype=self.dtype,
+            name="c_fc",
+        )
+
+        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_proj = ParameterizedLinear(
+            features=config.n_embd,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("mlp", "embed"),
+            dtype=self.dtype,
+            name="c_proj",
+        )
+
+        act = get_activation_function(config.activation_function)
+        h = c_fc(hidden_states)
+        h = nn.with_logical_constraint(h, ("act_batch", "act_seq", "act_mlp"))
+        h = act(h)
+        h = c_proj(h)
+        h = nn.Dropout(rate=config.resid_pdrop)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block with µP residual multiplier
+    (reference `gpt_dolomite/layer.py:11-86`)."""
+
+    config: CommonConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None = None,
+        alibi_bias: jax.Array | None = None,
+        kv_cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        config = self.config
+        m_residual = config.m_residual
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_1")(hidden_states)
+        attn_out, kv_cache = Attention(
+            config=config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            name="attn",
+        )(
+            h,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            rope_cos_sin=rope_cos_sin,
+            alibi_bias=alibi_bias,
+            kv_cache=kv_cache,
+            cache_index=cache_index,
+            deterministic=deterministic,
+        )
+        if m_residual is not None:
+            attn_out = attn_out * m_residual
+        hidden_states = residual + attn_out
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_2")(hidden_states)
+        mlp_out = MLP(config=config, dtype=self.dtype, name="mlp")(h, deterministic=deterministic)
+        if m_residual is not None:
+            mlp_out = mlp_out * m_residual
+        hidden_states = residual + mlp_out
+
+        hidden_states = nn.with_logical_constraint(
+            hidden_states, ("act_batch", "act_seq", "act_embed")
+        )
+        return hidden_states, kv_cache
+
+
+def compute_position_stuff(
+    config: CommonConfig,
+    position_ids: jax.Array,
+    rope_params: RoPEParams | None,
+    num_heads: int,
+    attention_mask: jax.Array | None,
+    batch: int,
+    key_length: int,
+    dtype: Dtype,
+):
+    """Shared position-embedding precompute: rope cos/sin or alibi bias for all layers."""
+    from ..ops.alibi import get_alibi_bias
+
+    pe_type = PositionEmbeddingType(config.position_embedding_type)
+    rope_cos_sin = None
+    alibi_bias = None
+    if pe_type == PositionEmbeddingType.rope:
+        assert rope_params is not None
+        rope_cos_sin = get_cos_sin(rope_params, position_ids, dtype=dtype)
+    elif pe_type == PositionEmbeddingType.alibi:
+        alibi_bias = get_alibi_bias(num_heads, attention_mask, batch, key_length, dtype=jnp.float32)
+    return rope_cos_sin, alibi_bias

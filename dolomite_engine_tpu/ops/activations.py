@@ -1,0 +1,120 @@
+"""Activation registry (base + GLU family), functional JAX versions.
+
+Parity: reference `hf_models/modeling_utils/activations/` — `base.py` registers ~28 named base
+activations, `glu.py:22-50` defines GLU semantics: chunk last dim in two, return
+``x_up * act(x_gate)`` (up is the FIRST chunk, gated is the SECOND), `is_glu` = name ends with
+"glu", plus the short-name mapping (swiglu -> swish etc.). Keeping the exact chunk order matters
+for fused-c_fc weight layout compatibility in HF conversion.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jax.Array], jax.Array]
+
+
+def _laplace(x, mu: float = 0.707107, sigma: float = 0.282095):
+    return 0.5 * (1.0 + jax.lax.erf((x - mu) / (sigma * math.sqrt(2.0))))
+
+
+def _relu2(x):
+    return jnp.square(jax.nn.relu(x))
+
+
+def _hard_shrink(x, lambd: float = 0.5):
+    return jnp.where(jnp.abs(x) > lambd, x, 0.0)
+
+
+def _soft_shrink(x, lambd: float = 0.5):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - lambd, 0.0)
+
+
+def _tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+_BASE_ACTIVATIONS: dict[str, Activation] = {
+    "celu": jax.nn.celu,
+    "elu": jax.nn.elu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "selu": jax.nn.selu,
+    "hard_shrink": _hard_shrink,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "hard_swish": jax.nn.hard_swish,
+    "hard_tanh": jax.nn.hard_tanh,
+    "laplace": _laplace,
+    "leaky_reLU": jax.nn.leaky_relu,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "mish": _mish,
+    "prelu": lambda x: jnp.where(x >= 0, x, 0.25 * x),
+    "relu": jax.nn.relu,
+    "relu2": _relu2,
+    "relu_squared": _relu2,
+    "relu6": jax.nn.relu6,
+    "rrelu": lambda x: jnp.where(x >= 0, x, (1.0 / 8 + 1.0 / 3) / 2 * x),  # eval-mode rrelu
+    "sigmoid": jax.nn.sigmoid,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "softplus": jax.nn.softplus,
+    "soft_plus": jax.nn.softplus,
+    "soft_shrink": _soft_shrink,
+    "soft_sign": jax.nn.soft_sign,
+    "tanh": jnp.tanh,
+    "tanh_shrink": _tanh_shrink,
+}
+
+_GLU_BASE_MAPPING = {
+    "ceglu": "celu",
+    "eglu": "elu",
+    "geglu": "gelu",
+    "miglu": "mish",
+    "mishglu": "mish",
+    "preglu": "prelu",
+    "reglu": "relu",
+    "rreglu": "rrelu",
+    "seglu": "selu",
+    "swiglu": "swish",
+}
+
+
+def is_glu(name: str) -> bool:
+    return name.endswith("glu")
+
+
+def get_base_activation(name: str) -> Activation:
+    if name in _BASE_ACTIVATIONS:
+        return _BASE_ACTIVATIONS[name]
+    raise ValueError(f"invalid activation function '{name}'")
+
+
+def get_glu_activation(name: str) -> Activation:
+    if name in ("glu", "sigmoid_glu"):
+        base = jax.nn.sigmoid
+    else:
+        if name in _GLU_BASE_MAPPING:
+            name = _GLU_BASE_MAPPING[name]
+        elif name.endswith("_glu"):
+            name = name[: -len("_glu")]
+        else:
+            raise ValueError(f"invalid activation function '{name}'")
+        base = get_base_activation(name)
+
+    def glu(x: jax.Array) -> jax.Array:
+        up, gate = jnp.split(x, 2, axis=-1)
+        return up * base(gate)
+
+    return glu
+
+
+def get_activation_function(name: str) -> Activation:
+    return get_glu_activation(name) if is_glu(name) else get_base_activation(name)

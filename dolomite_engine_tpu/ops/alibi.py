@@ -1,0 +1,52 @@
+"""ALiBi linear attention biases.
+
+Parity: reference `hf_models/modeling_utils/position_embedding/alibi.py:7-45`: geometric slope
+schedule with the non-power-of-2 head-count extension, bias = slope * key position (position
+derived from the attention mask cumsum so left-padding is handled).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_alibi_slopes(num_heads: int) -> np.ndarray:
+    """[num_heads] float32 slopes (static)."""
+    closest_power_of_2 = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest_power_of_2) - 3)))
+    powers = np.arange(1, 1 + closest_power_of_2, dtype=np.float32)
+    slopes = np.power(base, powers)
+
+    if closest_power_of_2 != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest_power_of_2) - 3)))
+        num_remaining = min(closest_power_of_2, num_heads - closest_power_of_2)
+        extra_powers = np.arange(1, 1 + 2 * num_remaining, 2, dtype=np.float32)
+        slopes = np.concatenate([slopes, np.power(extra_base, extra_powers)])
+
+    return slopes.astype(np.float32)
+
+
+def get_alibi_bias(
+    num_heads: int,
+    attention_mask: jax.Array | None,
+    batch_size: int,
+    key_length: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Bias of shape [batch, num_heads, 1, key_length] added to attention scores."""
+    slopes = jnp.asarray(get_alibi_slopes(num_heads))  # [H]
+
+    if attention_mask is None:
+        key_positions = jnp.broadcast_to(
+            jnp.arange(key_length, dtype=jnp.float32)[None, :], (batch_size, key_length)
+        )
+    else:
+        cumsum = jnp.cumsum(attention_mask.astype(jnp.float32), axis=-1) - 1.0
+        key_positions = jnp.where(attention_mask == 0, 0.0, cumsum)
+
+    bias = slopes[None, :, None, None] * key_positions[:, None, None, :]
+    return bias.astype(dtype)

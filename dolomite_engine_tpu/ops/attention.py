@@ -1,0 +1,206 @@
+"""Unified attention op: eager / XLA-fused (sdpa) / Pallas flash with segment ids.
+
+Parity: reference `hf_models/modeling_utils/attention/` implements four paths — eager fp32-softmax
+matmul (`base.py:234-259`), SDPA (`sdpa.py:11-86`), FlashAttention2 with unpad/pad
+(`flash.py:16-140`), and PaddingFreeAttention over packed `cu_seqlens` tensors
+(`padding_free.py:14-77`). The TPU design collapses FlashAttention2 + PaddingFree into ONE path:
+packed sequences with **segment ids** (the TPU-native replacement for varlen cu_seqlens) running a
+Pallas flash kernel; "sdpa" maps to XLA's fused `jax.nn.dot_product_attention`; "eager" is the
+fp32-softmax debug/parity path. GQA/MQA head broadcast replaces `repeat_key_value`
+(`attention/utils.py:5-118`).
+
+All shapes are batch-first: q [B, Sq, Hq, D]; k, v [B, Skv, Hkv, D]. Packed (padding-free) input
+is [B, S] tokens + segment_ids [B, S] (0 = padding, 1.. = documents); this also implements
+`reset_attention_mask` document isolation (reference `model_wrapper/pretraining.py:129-160`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..enums import AttentionImplementation
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def make_attention_mask(
+    batch_size: int,
+    query_length: int,
+    key_length: int,
+    causal: bool = True,
+    attention_mask: jax.Array | None = None,
+    segment_ids_q: jax.Array | None = None,
+    segment_ids_kv: jax.Array | None = None,
+    query_offset: jax.Array | int = 0,
+) -> jax.Array | None:
+    """Boolean [B, 1, Sq, Skv] mask (True = attend), or None when fully visible."""
+    mask = None
+
+    if causal:
+        q_pos = jnp.arange(query_length)[:, None] + query_offset
+        k_pos = jnp.arange(key_length)[None, :]
+        mask = (k_pos <= q_pos)[None, None]
+
+    if attention_mask is not None:
+        pad = attention_mask.astype(bool)[:, None, None, :]  # [B, 1, 1, Skv]
+        mask = pad if mask is None else jnp.logical_and(mask, pad)
+
+    if segment_ids_q is not None or segment_ids_kv is not None:
+        if segment_ids_kv is None:
+            segment_ids_kv = segment_ids_q
+        if segment_ids_q is None:
+            segment_ids_q = segment_ids_kv
+        seg = segment_ids_q[:, None, :, None] == segment_ids_kv[:, None, None, :]
+        nonpad = (segment_ids_kv != 0)[:, None, None, :]
+        seg = jnp.logical_and(seg, nonpad)
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+
+    return mask
+
+
+def _repeat_kv(k: jax.Array, num_query_heads: int) -> jax.Array:
+    """Expand KV heads to match query heads (reference `attention/utils.py` repeat_key_value)."""
+    num_kv = k.shape[2]
+    if num_kv == num_query_heads:
+        return k
+    return jnp.repeat(k, num_query_heads // num_kv, axis=2)
+
+
+def eager_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None,
+    bias: jax.Array | None,
+    softmax_scale: float,
+    softmax_in_fp32: bool = True,
+    dropout: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+) -> jax.Array:
+    """Explicit QK^T -> softmax -> V (reference `attention/base.py:234-259`): scores scaled by
+    softmax_scale, optional additive bias (alibi), softmax upcast to fp32."""
+    input_dtype = q.dtype
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * softmax_scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+    if softmax_in_fp32:
+        scores = scores.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(input_dtype)
+
+    if dropout > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def sdpa_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None,
+    bias: jax.Array | None,
+    softmax_scale: float,
+) -> jax.Array:
+    """XLA fused attention; GQA/MQA handled natively by `jax.nn.dot_product_attention`."""
+    return jax.nn.dot_product_attention(
+        q, k, v, bias=bias, mask=mask, scale=softmax_scale, implementation="xla"
+    )
+
+
+def _tpu_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    bias: jax.Array | None,
+    segment_ids: jax.Array | None,
+    causal: bool,
+    softmax_scale: float,
+) -> jax.Array:
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    # kernel expects [B, H, S, D] with equal head counts
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(_repeat_kv(k, q.shape[2]), 1, 2)
+    vt = jnp.swapaxes(_repeat_kv(v, q.shape[2]), 1, 2)
+
+    seg = None
+    if segment_ids is not None:
+        seg_i = segment_ids.astype(jnp.int32)
+        seg = _fa.SegmentIds(q=seg_i, kv=seg_i)
+
+    ab = None
+    if bias is not None:
+        ab = jnp.broadcast_to(bias, (q.shape[0], q.shape[2], q.shape[1], k.shape[1])).astype(
+            jnp.float32
+        )
+
+    out = _fa.flash_attention(qt, kt, vt, ab=ab, segment_ids=seg, causal=causal, sm_scale=softmax_scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    implementation: AttentionImplementation = AttentionImplementation.sdpa,
+    causal: bool = True,
+    softmax_scale: float | None = None,
+    attention_mask: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
+    alibi_bias: jax.Array | None = None,
+    softmax_in_fp32: bool = True,
+    dropout: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    query_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Dispatch to the configured implementation; returns [B, Sq, Hq, D]."""
+    if softmax_scale is None:
+        softmax_scale = q.shape[-1] ** -0.5
+
+    use_flash = (
+        implementation == AttentionImplementation.flash_attention_2
+        and jax.default_backend() == "tpu"
+        and dropout == 0.0
+        and attention_mask is None
+        and q.shape[1] == k.shape[1]  # no decode-with-cache in the kernel path
+    )
+    if use_flash:
+        return _tpu_flash_attention(q, k, v, alibi_bias, segment_ids, causal, softmax_scale)
+
+    if segment_ids is not None and q.shape[1] != k.shape[1]:
+        raise NotImplementedError("packed segment attention with KV cache is not supported")
+
+    mask = make_attention_mask(
+        q.shape[0],
+        q.shape[1],
+        k.shape[1],
+        causal=causal,
+        attention_mask=attention_mask,
+        segment_ids_q=segment_ids,
+        query_offset=query_offset,
+    )
+
+    if implementation == AttentionImplementation.eager or dropout > 0.0:
+        return eager_attention(
+            q,
+            k,
+            v,
+            mask,
+            alibi_bias,
+            softmax_scale,
+            softmax_in_fp32=softmax_in_fp32,
+            dropout=dropout,
+            dropout_rng=dropout_rng,
+        )
+
+    return sdpa_attention(q, k, v, mask, alibi_bias, softmax_scale)

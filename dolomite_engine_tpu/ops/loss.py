@@ -1,0 +1,110 @@
+"""Loss functions: causal-LM cross entropy + MoE load-balancing aux loss.
+
+Parity:
+  - pretraining CE on shifted tokens: reference `model_wrapper/pretraining.py:89-127` computes
+    loss externally with `F.cross_entropy` on fp32-upcast logits; labels = inputs shifted by one.
+  - padding-free boundary masking: reference `gpt_dolomite/main.py:179-202` masks the shift across
+    document boundaries via cu_seqlens; here that falls out of segment_ids (label position whose
+    segment differs from its input position is ignored).
+  - loss_parallel (vocab-TP CE, `gpt_dolomite_TP/main.py:158-166`): on TPU the logits stay
+    vocab-sharded ("act_vocab" -> tp); the logsumexp/gather below is computed by GSPMD with a psum
+    over the tp axis — no explicit collective code needed.
+  - MoE aux loss: reference `moe_dolomite/moe/base.py:24-43` reuses HF mixtral
+    `load_balancing_loss_func` (switch-transformer style fraction-of-tokens x router-prob).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    upcast: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Token-level CE. logits [..., V]; labels [...] with IGNORE_INDEX masking.
+
+    Returns (sum_loss, num_tokens) so callers can all-reduce numerator/denominator separately
+    (exact mean over the global batch regardless of per-shard masking).
+    """
+    if upcast:
+        logits = logits.astype(jnp.float32)
+
+    mask = labels != IGNORE_INDEX
+    safe_labels = jnp.where(mask, labels, 0)
+
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    token_logprobs = jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+
+    loss_sum = -jnp.sum(jnp.where(mask, token_logprobs, 0.0))
+    num_tokens = jnp.sum(mask.astype(jnp.float32))
+    return loss_sum, num_tokens
+
+
+def causal_lm_loss(
+    logits: jax.Array,
+    input_ids: jax.Array,
+    upcast: bool = True,
+    attention_mask: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
+    labels: jax.Array | None = None,
+) -> jax.Array:
+    """Mean next-token CE over valid positions.
+
+    If `labels` is None, labels are `input_ids` shifted left by one. Positions are dropped when:
+    the shifted-out last position, padding (attention_mask == 0 / segment 0), or a document
+    boundary (segment of label != segment of input — the `reset_attention_mask` doc isolation).
+    """
+    if labels is None:
+        labels = jnp.concatenate(
+            [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1
+        )
+        if attention_mask is not None:
+            shifted_mask = jnp.concatenate(
+                [attention_mask[:, 1:], jnp.zeros_like(attention_mask[:, :1])], axis=1
+            )
+            labels = jnp.where(shifted_mask.astype(bool), labels, IGNORE_INDEX)
+        if segment_ids is not None:
+            next_seg = jnp.concatenate(
+                [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+            )
+            valid = (next_seg == segment_ids) & (segment_ids != 0)
+            labels = jnp.where(valid, labels, IGNORE_INDEX)
+
+    loss_sum, num_tokens = cross_entropy_loss(logits, labels, upcast=upcast)
+    return loss_sum / jnp.maximum(num_tokens, 1.0)
+
+
+def load_balancing_loss(
+    router_logits: jax.Array,
+    num_experts: int,
+    num_experts_per_tok: int,
+    valid_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Switch-Transformer load balancing loss over all layers' router logits, matching HF
+    mixtral `load_balancing_loss_func` exactly: layers are CONCATENATED into one token axis
+    (mean over L*T), the top-k axis is SUMMED, result scaled by num_experts.
+
+    router_logits: [layers, tokens, num_experts] (or [tokens, num_experts]).
+    """
+    if router_logits.ndim == 3:
+        router_logits = router_logits.reshape(-1, num_experts)
+
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [LT, E]
+    _, top_idx = jax.lax.top_k(probs, num_experts_per_tok)
+    expert_mask = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)  # [LT, K, E]
+
+    if valid_mask is not None:
+        w = jnp.tile(valid_mask.astype(jnp.float32).reshape(-1), probs.shape[0] // valid_mask.size)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        tokens_per_expert = jnp.einsum("tke,t->ke", expert_mask, w) / denom  # [K, E]
+        router_prob_per_expert = jnp.einsum("te,t->e", probs, w) / denom  # [E]
+    else:
+        tokens_per_expert = jnp.mean(expert_mask, axis=0)  # [K, E]
+        router_prob_per_expert = jnp.mean(probs, axis=0)  # [E]
+
+    return jnp.sum(tokens_per_expert * router_prob_per_expert[None, :]) * num_experts

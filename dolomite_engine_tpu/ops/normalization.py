@@ -1,0 +1,45 @@
+"""LayerNorm / RMSNorm, functional.
+
+Parity: reference `hf_models/modeling_utils/normalization/` registers {layernorm, rmsnorm} x
+{torch, apex, apex_persistent / torchtitan-Triton}. On TPU there is exactly one implementation
+per norm: XLA fuses the reduction+scale into neighbouring ops, which is what the apex/Triton
+kernels buy on GPU, so the kernel-variant axis collapses. RMSNorm math matches the reference pure
+impl (`rmsnorm/base.py:7-33`): accumulate in fp32, scale, cast back.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NORM_FUNCTIONS = ("layernorm", "rmsnorm")
+
+
+def check_normalization_function(name: str) -> None:
+    if name not in _NORM_FUNCTIONS:
+        raise ValueError(f"unexpected normalization function '{name}'")
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array | None, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    variance = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(variance + eps)
+    if weight is not None:
+        x = x.astype(dtype) * weight.astype(dtype)
+    return x.astype(dtype)
+
+
+def layernorm(
+    x: jax.Array, weight: jax.Array | None, bias: jax.Array | None, eps: float
+) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        x = x * weight.astype(jnp.float32)
+    if bias is not None:
+        x = x + bias.astype(jnp.float32)
+    return x.astype(dtype)

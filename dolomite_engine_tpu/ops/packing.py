@@ -1,0 +1,92 @@
+"""Padding-free / packed-sequence utilities.
+
+Parity: reference `hf_models/utils.py:20-74` (`convert_padding_free_lists_to_tensors`) flattens
+list-of-lists into packed tensors + `cu_seqlens`/`max_seqlen`/`position_ids`. The TPU-native
+packed representation is **fixed-shape** [B, S] token blocks with `segment_ids` (0 = padding,
+1.. = document index) and per-document `position_ids` — XLA requires static shapes, so ragged
+cu_seqlens tensors are converted at the host boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_sequences(
+    sequences: list[list[int]],
+    max_length: int,
+    pad_token_id: int = 0,
+    labels: list[list[int]] | None = None,
+    ignore_index: int = -100,
+) -> dict[str, np.ndarray]:
+    """Pack variable-length docs into one [1, max_length] row (padding-free single example form).
+
+    Returns input_ids, position_ids (reset per doc), segment_ids (1-indexed per doc), labels.
+    """
+    total = sum(len(s) for s in sequences)
+    if total > max_length:
+        raise ValueError(f"packed length {total} exceeds max_length {max_length}")
+
+    input_ids = np.full((max_length,), pad_token_id, dtype=np.int32)
+    position_ids = np.zeros((max_length,), dtype=np.int32)
+    segment_ids = np.zeros((max_length,), dtype=np.int32)
+    out_labels = np.full((max_length,), ignore_index, dtype=np.int32)
+
+    offset = 0
+    for doc_idx, seq in enumerate(sequences):
+        n = len(seq)
+        input_ids[offset : offset + n] = seq
+        position_ids[offset : offset + n] = np.arange(n)
+        segment_ids[offset : offset + n] = doc_idx + 1
+        if labels is not None:
+            out_labels[offset : offset + n] = labels[doc_idx]
+        offset += n
+
+    return {
+        "input_ids": input_ids[None],
+        "position_ids": position_ids[None],
+        "segment_ids": segment_ids[None],
+        "labels": out_labels[None] if labels is not None else None,
+    }
+
+
+def segment_ids_from_eos(
+    tokens: np.ndarray, eos_token_id: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Derive (segment_ids, position_ids) from EOS positions in a packed [B, S] token block.
+
+    Parity: reference `model_wrapper/pretraining.py:129-160` builds per-batch document-boundary
+    cu_seqlens from EOS positions when `reset_attention_mask`/`reset_position_ids` are on.
+    A document ends AT its EOS token (the EOS belongs to the preceding doc).
+    """
+    tokens = np.asarray(tokens)
+    is_eos = tokens == eos_token_id
+    # segment index increments AFTER each eos
+    seg = np.cumsum(np.concatenate([np.zeros_like(is_eos[:, :1]), is_eos[:, :-1]], axis=1), axis=1)
+    segment_ids = (seg + 1).astype(np.int32)
+
+    # positions reset at each segment start
+    idx = np.arange(tokens.shape[1])[None, :]
+    seg_change = np.concatenate(
+        [np.zeros_like(is_eos[:, :1], dtype=bool), is_eos[:, :-1]], axis=1
+    )
+    start_idx = np.where(seg_change, idx, 0)
+    start_idx = np.maximum.accumulate(start_idx, axis=1)
+    position_ids = (idx - start_idx).astype(np.int32)
+    return segment_ids, position_ids
+
+
+def cu_seqlens_to_segment_ids(cu_seqlens: np.ndarray, total_length: int) -> np.ndarray:
+    """[num_docs+1] cumulative lengths -> [total_length] 1-indexed segment ids."""
+    segment_ids = np.zeros((total_length,), dtype=np.int32)
+    for i in range(len(cu_seqlens) - 1):
+        segment_ids[cu_seqlens[i] : cu_seqlens[i + 1]] = i + 1
+    return segment_ids
+
+
+def segment_ids_to_cu_seqlens(segment_ids: np.ndarray) -> np.ndarray:
+    """Inverse of the above for a single packed row (ignores trailing padding zeros)."""
+    segment_ids = np.asarray(segment_ids).reshape(-1)
+    lengths = np.bincount(segment_ids)[1:]  # drop padding bucket 0
+    lengths = lengths[lengths > 0]
+    return np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)

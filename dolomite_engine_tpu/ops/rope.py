@@ -1,0 +1,115 @@
+"""Rotary position embeddings with YaRN scaling, functional.
+
+Parity: reference `hf_models/modeling_utils/position_embedding/rope.py:9-148` (`RoPE`,
+`YaRNScaledRoPE`, `apply_rotary_pos_emb`): non-interleaved rotate-half layout (freqs concatenated,
+not interleaved), YaRN = interpolation/extrapolation blend via a linear ramp over frequency dims
+plus an `mscale` magnitude correction (`0.1*ln(scale)+1`). The reference caches cos/sin in module
+buffers; here everything derives from `position_ids` inside the traced function — XLA constant-
+folds the inv_freq table and fuses the rest, so a host-side cache buys nothing on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RoPEParams:
+    """Static (trace-time) rotary parameters derived from config."""
+
+    inv_freq: np.ndarray  # [head_dim // 2], float32 — static, constant-folded by XLA
+    mscale: float
+
+    @staticmethod
+    def from_config(
+        head_dim: int,
+        base: float = 10000,
+        rope_scaling: dict | None = None,
+        max_position_embeddings: int = 2048,
+    ) -> "RoPEParams":
+        dim_range = np.arange(0, head_dim, 2, dtype=np.float32) / head_dim
+        pos_freqs = base**dim_range
+
+        if rope_scaling is None:
+            return RoPEParams(inv_freq=1.0 / pos_freqs, mscale=1.0)
+
+        scaling_type = rope_scaling.get("type", "yarn")
+        if scaling_type != "yarn":
+            raise ValueError(f"unexpected rope_scaling type '{scaling_type}'")
+
+        scale = rope_scaling.get("factor", 1.0)
+        original_max = rope_scaling.get(
+            "original_max_position_embeddings", max_position_embeddings
+        )
+        extrapolation_factor = rope_scaling.get("extrapolation_factor", 1.0)
+        attn_factor = rope_scaling.get("attn_factor", 1.0)
+        beta_fast = rope_scaling.get("beta_fast", 32)
+        beta_slow = rope_scaling.get("beta_slow", 1)
+
+        inv_freq_extrapolation = 1.0 / pos_freqs
+        inv_freq_interpolation = 1.0 / (scale * pos_freqs)
+
+        low, high = _yarn_correction_range(beta_fast, beta_slow, head_dim, base, original_max)
+        # ramp 0 -> 1 over [low, high]; mask = fraction of EXTRApolation per frequency dim
+        inv_freq_mask = (1.0 - _linear_ramp(low, high, head_dim // 2)) * extrapolation_factor
+        inv_freq = (
+            inv_freq_interpolation * (1.0 - inv_freq_mask) + inv_freq_extrapolation * inv_freq_mask
+        )
+
+        mscale = _yarn_get_mscale(scale) * attn_factor
+        return RoPEParams(inv_freq=inv_freq.astype(np.float32), mscale=float(mscale))
+
+
+def get_cos_sin(
+    rope: RoPEParams, position_ids: jax.Array, dtype=jnp.float32
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin of shape [..., seq, head_dim] for the given position ids [..., seq]."""
+    freqs = position_ids[..., None].astype(jnp.float32) * jnp.asarray(rope.inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return (jnp.cos(emb) * rope.mscale).astype(dtype), (jnp.sin(emb) * rope.mscale).astype(dtype)
+
+
+def apply_rotary_pos_emb(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., seq, heads, head_dim]; cos/sin: [..., seq, head_dim] (broadcast over heads)."""
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    return (x * cos) + (_rotate_half(x) * sin)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _yarn_correction_dim(
+    num_rotations: float, dim: int, base: float, max_position_embeddings: int
+) -> float:
+    return (dim * math.log(max_position_embeddings / (num_rotations * 2 * math.pi))) / (
+        2 * math.log(base)
+    )
+
+
+def _yarn_correction_range(
+    low_rot: float, high_rot: float, dim: int, base: float, max_position_embeddings: int
+) -> tuple[int, int]:
+    low = math.floor(_yarn_correction_dim(low_rot, dim, base, max_position_embeddings))
+    high = math.ceil(_yarn_correction_dim(high_rot, dim, base, max_position_embeddings))
+    return max(low, 0), min(high, dim - 1)
+
+
+def _linear_ramp(low: float, high: float, dim: int) -> np.ndarray:
+    if low == high:
+        high += 0.001
+    ramp = (np.arange(dim, dtype=np.float32) - low) / (high - low)
+    return np.clip(ramp, 0.0, 1.0)
+
+
+def _yarn_get_mscale(scale: float) -> float:
+    if scale <= 1:
+        return 1.0
+    return 0.1 * math.log(scale) + 1.0

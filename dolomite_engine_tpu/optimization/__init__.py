@@ -1,0 +1,2 @@
+from .optimizer import get_mup_label_tree, get_optimizer
+from .scheduler import get_scheduler, get_scheduler_factor

@@ -1,0 +1,134 @@
+"""LR schedules: warmup -> constant -> decay piecewise families.
+
+Parity: reference `dolomite_engine/optimization/scheduler.py:1-219` — constant / linear / cosine /
+exponential / power schedules sharing the boundary logic: linear warmup over `num_warmup_steps`,
+flat until `num_warmup_steps + num_constant_steps`, decay until `num_training_steps` (or
+`num_constant_boundary + num_decay_steps` when given), floored at `lr_decay_factor`. The power
+schedule (`a * (x*c)**b` capped at 1, Power-LR paper) takes a/b/c via `extra_lr_scheduler_args`.
+
+Here schedules are pure `step -> multiplicative factor` callables fed to optax (multiplied with
+the base lr inside the optimizer); they operate on python ints or jnp arrays (used inside jit).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+
+from ..enums import LRDecaySchedule
+
+Schedule = Callable[[jnp.ndarray | int], jnp.ndarray | float]
+
+
+def get_scheduler_factor(
+    num_warmup_steps: int,
+    num_constant_steps: int,
+    num_decay_steps: int | None,
+    num_training_steps: int,
+    lr_decay_style: LRDecaySchedule,
+    lr_decay_factor: float,
+    extra_lr_scheduler_args: dict | None = None,
+    base_lr: float | None = None,
+) -> Schedule:
+    """Returns f(step) in [0, 1]; multiply with base lr."""
+    extra = extra_lr_scheduler_args or {}
+
+    warmup_boundary = num_warmup_steps
+    constant_boundary = warmup_boundary + num_constant_steps
+    decay_boundary = num_training_steps
+    if num_decay_steps is not None:
+        decay_boundary = constant_boundary + num_decay_steps
+    decay_span = max(decay_boundary - constant_boundary, 1)
+
+    if lr_decay_style == LRDecaySchedule.constant:
+        assert num_constant_steps == 0 or True  # constant after warmup; decay args unused
+
+        def factor(step):
+            w = jnp.where(
+                (warmup_boundary > 0) & (step <= warmup_boundary),
+                step / max(warmup_boundary, 1),
+                1.0,
+            )
+            return w
+
+    elif lr_decay_style == LRDecaySchedule.cosine:
+
+        def factor(step):
+            x = jnp.clip(step - constant_boundary, 0, decay_span)
+            decay = (1 - lr_decay_factor) * (1 + jnp.cos(jnp.pi * x / decay_span)) / 2 + lr_decay_factor
+            return _with_warmup(step, warmup_boundary, constant_boundary, decay)
+
+    elif lr_decay_style == LRDecaySchedule.linear:
+
+        def factor(step):
+            x = jnp.clip(step - constant_boundary, 0, decay_span)
+            decay = 1 + (lr_decay_factor - 1) * x / decay_span
+            return _with_warmup(step, warmup_boundary, constant_boundary, decay)
+
+    elif lr_decay_style == LRDecaySchedule.exponential:
+        # full decay phase (no flat tail), normalized so f(0)=1, f(decay_span)=lr_decay_factor
+        e = math.e
+        a = (1 - lr_decay_factor) * e / (e - 1)
+        b = (lr_decay_factor * e - 1) / (e - 1)
+
+        def factor(step):
+            x = jnp.maximum(step - constant_boundary, 0)
+            decay = a * jnp.exp(-x / decay_span) + b
+            return _with_warmup(step, warmup_boundary, constant_boundary, decay)
+
+    elif lr_decay_style == LRDecaySchedule.power:
+        assert num_constant_steps == 0, "num_constant_steps should be 0 for power law scheduler"
+        assert base_lr is not None, "power schedule needs the base lr to normalize `a`"
+        pa, pb, pc = extra["a"], extra["b"], extra["c"]
+        max_warmup_factor = min(1.0, (pa / base_lr) * (max(num_warmup_steps, 1) * pc) ** pb)
+
+        def factor(step):
+            step_f = jnp.asarray(step, jnp.float32)
+            power = jnp.minimum(1.0, (pa / base_lr) * jnp.maximum(step_f * pc, 1e-30) ** pb)
+            warm = max_warmup_factor * step_f / max(warmup_boundary, 1)
+            return jnp.where(
+                (warmup_boundary > 0) & (step_f <= warmup_boundary), warm, power
+            )
+
+    else:
+        raise ValueError(f"invalid lr_decay_style ({lr_decay_style})")
+
+    return factor
+
+
+def _with_warmup(step, warmup_boundary: int, constant_boundary: int, decay_value):
+    step_f = jnp.asarray(step, jnp.float32)
+    warm = step_f / max(warmup_boundary, 1)
+    return jnp.where(
+        (warmup_boundary > 0) & (step_f <= warmup_boundary),
+        warm,
+        jnp.where(step_f <= constant_boundary, 1.0, decay_value),
+    )
+
+
+def get_scheduler(
+    num_warmup_steps: int,
+    num_constant_steps: int,
+    num_decay_steps: int | None,
+    num_training_steps: int,
+    lr_decay_style: LRDecaySchedule | str,
+    lr_decay_factor: float,
+    extra_lr_scheduler_args: dict | None = None,
+    base_lr: float = 1.0,
+) -> Schedule:
+    """Returns f(step) -> absolute lr (optax schedule)."""
+    if isinstance(lr_decay_style, str):
+        lr_decay_style = LRDecaySchedule(lr_decay_style)
+    f = get_scheduler_factor(
+        num_warmup_steps,
+        num_constant_steps,
+        num_decay_steps,
+        num_training_steps,
+        lr_decay_style,
+        lr_decay_factor,
+        extra_lr_scheduler_args,
+        base_lr=base_lr,
+    )
+    return lambda step: base_lr * f(step)

@@ -1,0 +1,15 @@
+from .mesh import (
+    BATCH_AXES,
+    MESH_AXES,
+    MeshManager,
+    batch_sharding,
+    get_mesh,
+    make_default_mesh,
+    named_sharding,
+    temporary_mesh,
+)
+from .sharding import (
+    get_logical_axis_rules,
+    logical_to_mesh_sharding,
+    replicated_sharding,
+)

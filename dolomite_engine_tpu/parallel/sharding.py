@@ -1,0 +1,90 @@
+"""Logical-axis sharding rules: the GSPMD replacement for the reference's manual parallelism.
+
+Parity map (reference -> here):
+  - FSDP/ZeRO stages (`dolomite_engine/distributed/__init__.py:118-220`): params/optimizer state
+    sharded over the "fsdp" mesh axis via partition rules; stage semantics:
+        stage 0 -> params + opt replicated (DDP)
+        stage 1/2 -> params replicated, optimizer state sharded ("fsdp")
+        stage 3 -> params AND optimizer state sharded
+    XLA emits exactly the all-gather/reduce-scatter schedule FSDP implements by hand.
+  - TP column/row parallel linears (`hf_models/modeling_utils_TP/linear.py:22-210`): the "tp"
+    entries below; GSPMD infers the all-reduce/reduce-scatter at row-parallel boundaries.
+  - Megatron-SP (`hf_models/modeling_utils_TP/TP.py:82-91` get_module_placements): activation
+    sequence axis additionally sharded over "tp" between TP regions.
+  - vocab/loss parallel (`gpt_dolomite_TP/main.py:96-167`): "vocab" -> "tp" +
+    sharded cross-entropy in ops/loss.py.
+  - EP (absent in reference, SURVEY §2.6): "experts" -> "ep".
+
+Model code declares params with `nn.with_partitioning(init, (logical, names))` and activations
+with `nn.with_logical_constraint`; these rules map logical names -> mesh axes.
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# logical axis names used across all models
+#   params: "vocab", "embed", "heads", "kv_heads", "mlp", "experts", None (replicated dims)
+#   activations: "act_batch", "act_seq", "act_embed", "act_heads", "act_mlp", "act_vocab"
+
+LogicalRules = list[tuple[str, tuple[str, ...] | str | None]]
+
+
+def get_logical_axis_rules(
+    stage: int = 3,
+    tensor_parallel_word_embeddings: bool = False,
+    sequence_parallel: bool = False,
+    for_optimizer: bool = False,
+) -> LogicalRules:
+    """Build logical->mesh rules for the given ZeRO stage / TP options.
+
+    ``for_optimizer``: optimizer-state copies of the params; differs from param rules only for
+    ZeRO stage 1/2 where opt state is sharded but params are not.
+    """
+    shard_params = stage >= 3 or (for_optimizer and stage >= 1)
+    fsdp = "fsdp" if shard_params else None
+
+    act_seq: tuple[str, ...] = ("sp", "tp") if sequence_parallel else ("sp",)
+
+    rules: LogicalRules = [
+        # parameter axes
+        ("vocab", "tp" if tensor_parallel_word_embeddings else fsdp),
+        ("embed", fsdp),
+        ("heads", "tp"),
+        ("kv_heads", "tp"),
+        ("mlp", "tp"),
+        ("experts", "ep"),
+        ("expert_mlp", "tp"),
+        # activation axes
+        ("act_batch", ("dp", "fsdp")),
+        ("act_seq", act_seq),
+        ("act_embed", None),
+        ("act_heads", "tp"),
+        ("act_kv_heads", "tp"),
+        ("act_mlp", "tp"),
+        ("act_vocab", "tp" if tensor_parallel_word_embeddings else None),
+        ("act_experts", "ep"),
+    ]
+    return rules
+
+
+def logical_to_mesh_sharding(logical_spec_tree, mesh: Mesh, rules: LogicalRules):
+    """Convert a pytree of logical PartitionSpecs (from `nn.get_partition_spec`) to
+    NamedShardings on `mesh`."""
+    return nn.logical_to_mesh_sharding(logical_spec_tree, mesh, rules)
+
+
+def get_abstract_state_shardings(abstract_tree, logical_spec_tree, mesh: Mesh, rules: LogicalRules):
+    """Pair an eval_shape tree with shardings derived from its logical specs."""
+    shardings = logical_to_mesh_sharding(logical_spec_tree, mesh, rules)
+    return jax.tree.map(
+        lambda shape, sharding: jax.ShapeDtypeStruct(shape.shape, shape.dtype, sharding=sharding),
+        abstract_tree,
+        shardings,
+    )
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,40 @@
+"""PEFT (LoRA / prompt tuning) for the JAX model family.
+
+Parity: reference uses HF peft (`model_wrapper/peft.py:9-45`); here adapters are native flax
+params and base-weight freezing is an optax mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from .lora import LoRACausalLM, lora_scope
+from .prompt_tuning import PromptTuningCausalLM
+
+_TRAINABLE_LEAF_NAMES = ("lora_a", "lora_b", "prompt_embeddings")
+
+
+def peft_trainable_mask(params) -> object:
+    """True = trainable (adapter params), False = frozen base weights."""
+
+    def label(path, leaf) -> bool:
+        keys = [getattr(p, "key", str(p)) for p in path]
+        return any(k in _TRAINABLE_LEAF_NAMES for k in keys)
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def freeze_base_weights(
+    inner: optax.GradientTransformation, params
+) -> optax.GradientTransformation:
+    """Apply `inner` to adapter params only; base weights get zero updates.
+
+    NOTE: `optax.masked` is NOT suitable here — it passes masked-out gradients through
+    UNCHANGED (they would be applied raw), so freezing requires multi_transform with
+    set_to_zero.
+    """
+    labels = jax.tree.map(
+        lambda trainable: "train" if trainable else "freeze", peft_trainable_mask(params)
+    )
+    return optax.multi_transform({"train": inner, "freeze": optax.set_to_zero()}, labels)

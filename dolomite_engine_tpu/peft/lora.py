@@ -1,0 +1,71 @@
+"""LoRA: low-rank adapters on attention projections.
+
+Parity: reference `model_wrapper/peft.py:9-45` wraps with HF peft `LoraConfig` (rank, alpha,
+dropout; default target = the fused attention projection). JAX design: `ParameterizedLinear`
+reads an ambient LoRA context during trace; targeted linears create `lora_a` (gaussian, fan-in
+std) and `lora_b` (zeros) adapter params and add `(alpha/rank) * dropout(x) @ a @ b` to their
+output. Base weights are frozen by the optimizer mask (`peft_trainable_mask`), the exact
+semantics of peft's requires_grad_(False).
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from flax import linen as nn
+
+
+@dataclass(frozen=True)
+class LoRAContext:
+    rank: int
+    alpha: float
+    dropout: float
+    targets: tuple[str, ...] = ("c_attn",)
+
+
+_ACTIVE: contextvars.ContextVar[LoRAContext | None] = contextvars.ContextVar(
+    "lora_context", default=None
+)
+
+
+def get_active_lora(module_name: str | None) -> LoRAContext | None:
+    ctx = _ACTIVE.get()
+    if ctx is None or module_name is None:
+        return None
+    if any(t in module_name for t in ctx.targets):
+        return ctx
+    return None
+
+
+@contextmanager
+def lora_scope(rank: int, alpha: float, dropout: float, targets: tuple[str, ...] = ("c_attn",)):
+    token = _ACTIVE.set(LoRAContext(rank, alpha, dropout, targets))
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+class LoRACausalLM(nn.Module):
+    """Wraps a causal-LM module; its trace runs inside a LoRA scope so targeted linears grow
+    adapters. Param tree nests under "base_model"."""
+
+    base_model: nn.Module
+    rank: int
+    alpha: float = 32.0
+    dropout: float = 0.1
+    targets: tuple[str, ...] = ("c_attn",)
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        with lora_scope(self.rank, self.alpha, self.dropout, self.targets):
+            return self.base_model(*args, **kwargs)
+
+    @property
+    def config(self):
+        return self.base_model.config
+
+    def init_kv_caches(self, *args, **kwargs):
+        return self.base_model.init_kv_caches(*args, **kwargs)

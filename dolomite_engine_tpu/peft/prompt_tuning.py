@@ -1,0 +1,88 @@
+"""Prompt tuning: trainable virtual-token embeddings prepended to the sequence.
+
+Parity: reference `model_wrapper/peft.py` with HF peft `PromptTuningConfig`
+(RANDOM or TEXT init; TEXT averages/tiles the tokenized init text's embeddings).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..ops.loss import IGNORE_INDEX
+
+
+class PromptTuningCausalLM(nn.Module):
+    base_model: nn.Module
+    num_virtual_tokens: int
+    init_text: str | None = None
+    tokenizer: object = None
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        labels=None,
+        position_ids=None,
+        segment_ids=None,
+        deterministic: bool = True,
+        **kwargs,
+    ):
+        if position_ids is not None or segment_ids is not None:
+            raise NotImplementedError("prompt tuning does not support padding-free batches")
+
+        batch, seq = input_ids.shape
+        v = self.num_virtual_tokens
+        embed_dim = self.base_model.config.n_embd
+
+        # embed real tokens first so the base wte params exist before a TEXT init reads them
+        inputs_embeds = self.base_model.transformer.wte(input_ids)
+
+        init_fn = nn.initializers.normal(0.02)
+        if self.init_text is not None and self.tokenizer is not None:
+            init_ids = self.tokenizer(self.init_text, add_special_tokens=False)["input_ids"]
+            init_ids = (init_ids * (v // max(len(init_ids), 1) + 1))[:v]
+
+            def init_fn(key, shape, dtype=jnp.float32):  # noqa: F811
+                # TEXT init: copy the base model's embedding rows of the init text
+                base_emb = self.base_model.transformer.wte.variables["params"]["embedding"]
+                if hasattr(base_emb, "unbox"):
+                    base_emb = base_emb.unbox()
+                return jnp.asarray(base_emb)[jnp.asarray(init_ids)].astype(dtype)
+
+        prompt_embeddings = self.param(
+            "prompt_embeddings",
+            nn.with_partitioning(init_fn, (None, "embed")),
+            (v, embed_dim),
+            jnp.float32,
+        )
+        virtual = jnp.broadcast_to(
+            prompt_embeddings[None].astype(inputs_embeds.dtype), (batch, v, embed_dim)
+        )
+        inputs_embeds = jnp.concatenate([virtual, inputs_embeds], axis=1)
+
+        if attention_mask is not None:
+            attention_mask = jnp.concatenate(
+                [jnp.ones((batch, v), attention_mask.dtype), attention_mask], axis=1
+            )
+        if labels is not None:
+            labels = jnp.concatenate(
+                [jnp.full((batch, v), IGNORE_INDEX, labels.dtype), labels], axis=1
+            )
+
+        output = self.base_model(
+            jnp.zeros((batch, seq + v), jnp.int32),  # ids unused when inputs_embeds given
+            inputs_embeds=inputs_embeds,
+            attention_mask=attention_mask,
+            labels=labels,
+            deterministic=deterministic,
+            **kwargs,
+        )
+        return output
+
+    @property
+    def config(self):
+        return self.base_model.config

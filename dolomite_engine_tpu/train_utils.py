@@ -1,0 +1,189 @@
+"""Shared training step + metrics + analytic FLOPs model.
+
+Parity: reference `dolomite_engine/train_utils.py` (236 LoC):
+  - `train_step` (18-116): grad-accum loop with FSDP no_sync on non-final micro-steps, grad
+    clip, loss all-reduce AVG over dp. TPU design: ONE jitted step takes the whole global-step
+    batch with a leading [grad_accum] axis and `lax.scan`s over micro-batches accumulating
+    fp32 grads — communication "deferral" is automatic (GSPMD reduces once, at use), and the
+    loss mean needs no explicit all-reduce (the batch axis is sharded over dp, reductions are
+    global under SPMD).
+  - metric formatting (119-179) -> `track_train_metrics`.
+  - torch profiler factory (182-194) -> `get_profiler_context` using jax.profiler.
+  - analytic TFLOPs model (197-236) -> `get_model_tflops` (same formula, checkpoint-aware).
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import nullcontext
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from .utils import ExperimentsTracker, log_rank_0
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def clip_grad_norm(grads, max_norm: float | None):
+    """Global-norm clip; returns (clipped_grads, grad_norm)."""
+    leaves = jax.tree.leaves(grads)
+    grad_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+    if max_norm is None:
+        return grads, grad_norm
+    scale = jnp.minimum(1.0, max_norm / (grad_norm + 1e-6))
+    return jax.tree.map(lambda g: g * scale, grads), grad_norm
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    gradient_accumulation_steps: int = 1,
+    gradient_clipping: float | None = 1.0,
+    rng_per_step: bool = True,
+):
+    """Build the jitted train step.
+
+    `loss_fn(params, micro_batch, rng) -> scalar loss`. `batch` passed to the returned step has
+    a leading [gradient_accumulation_steps] axis on every leaf.
+    """
+
+    def train_step(state: TrainState, batch, rng: jax.Array):
+        def micro_loss(params, micro_batch, micro_rng):
+            return loss_fn(params, micro_batch, micro_rng)
+
+        grad_fn = jax.value_and_grad(micro_loss)
+
+        if gradient_accumulation_steps == 1:
+            micro = jax.tree.map(lambda x: x[0], batch)
+            loss, grads = grad_fn(state.params, micro, rng)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        else:
+
+            def accum_fn(carry, xs):
+                grads_acc, loss_acc = carry
+                micro_batch, micro_rng = xs
+                loss, grads = grad_fn(state.params, micro_batch, micro_rng)
+                grads_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32) / gradient_accumulation_steps,
+                    grads_acc,
+                    grads,
+                )
+                return (grads_acc, loss_acc + loss / gradient_accumulation_steps), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            rngs = jax.random.split(rng, gradient_accumulation_steps)
+            (grads, loss), _ = jax.lax.scan(
+                accum_fn, (zero_grads, jnp.zeros((), jnp.float32)), (batch, rngs)
+            )
+
+        grads, grad_norm = clip_grad_norm(grads, gradient_clipping)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        metrics = {"loss": loss, "grad_norm": grad_norm}
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(loss_fn: Callable):
+    def eval_step(params, batch):
+        return loss_fn(params, batch, None)
+
+    return eval_step
+
+
+def track_train_metrics(
+    global_step: int,
+    train_loss_step: float,
+    grad_norm: float,
+    current_lr: float,
+    experiments_tracker: ExperimentsTracker | None,
+    loss_running_mean: float,
+    flops: float | None = None,
+    billion_tokens_per_day: float | None = None,
+    step_time: float | None = None,
+) -> None:
+    """Parity: reference `train_utils.py:119-179` metric names kept identical."""
+    metrics = {
+        "loss_step": train_loss_step,
+        "loss_running_mean": loss_running_mean,
+        "learning_rate": current_lr,
+    }
+    if grad_norm is not None:
+        metrics["grad_norm"] = grad_norm
+    if flops is not None:
+        metrics["FLOPS"] = flops
+    if billion_tokens_per_day is not None:
+        metrics["throughput (B tokens/day)"] = billion_tokens_per_day
+    if step_time is not None:
+        metrics["step time (sec)"] = step_time
+
+    if experiments_tracker is not None:
+        experiments_tracker.track(metrics, step=global_step, context="train")
+
+    message = f"step = {global_step}, " + ", ".join(
+        f"{k} = {v:.4g}" if isinstance(v, float) else f"{k} = {v}" for k, v in metrics.items()
+    )
+    log_rank_0(logging.INFO, message)
+
+
+def get_profiler_context(trace_path: str | None, step: int, wait: int = 5, active: int = 1):
+    """jax.profiler trace for steps [wait, wait+active) (reference torch-profiler schedule
+    `train_utils.py:182-194`: wait 5, warmup 5, active 1)."""
+    if trace_path is None:
+        return nullcontext()
+    if wait <= step < wait + active and jax.process_index() == 0:
+        return jax.profiler.trace(trace_path)
+    return nullcontext()
+
+
+def get_model_tflops(
+    config,
+    batch_size: int,
+    sequence_length: int,
+    gradient_checkpointing_method=None,
+    gradient_checkpointing_args: dict | None = None,
+) -> float:
+    """Analytic model TFLOPs per step per device-group (reference `train_utils.py:197-236`):
+    attn = 4bsh(h(1+k/n) + s), mlp = 4bshf (+2bshf GLU), lm_head = 6bshv, bwd = 2x fwd,
+    +1x fwd for each checkpointed block."""
+    from .ops.activations import is_glu
+
+    b = batch_size
+    s = sequence_length
+    h = config.n_embd
+    f = config.n_inner
+    n = config.n_head
+    k = config.num_key_value_heads
+    v = config.vocab_size
+    l = config.n_layer
+
+    attention_flops = 4 * b * s * h * (h * (1 + k / n) + s)
+    mlp_flops = 4 * b * s * h * f
+    if is_glu(config.activation_function):
+        mlp_flops += 2 * b * s * h * f
+
+    forward = l * (attention_flops + mlp_flops)
+    backward = 2 * forward
+
+    checkpointed_fraction = 0.0
+    if gradient_checkpointing_method is not None:
+        every = (gradient_checkpointing_args or {}).get("checkpoint_every", 1)
+        checkpointed_fraction = 1.0 / max(every, 1)
+    recompute = forward * checkpointed_fraction
+
+    lm_head = 6 * b * s * h * v
+
+    return (forward + backward + recompute + lm_head) / 1e12

@@ -1,0 +1,66 @@
+"""Generic utilities (reference `dolomite_engine/utils/`)."""
+
+import logging
+import os
+
+import jax
+
+from .logger import (
+    get_logger,
+    log_rank_0,
+    print_rank_0,
+    print_ranks_all,
+    run_rank_n,
+    set_logger,
+    warn_rank_0,
+)
+from .mixed_precision import dtype_to_string, normalize_dtype_string, string_to_dtype
+from .packages import (
+    is_aim_available,
+    is_colorlog_available,
+    is_torch_available,
+    is_transformers_available,
+    is_wandb_available,
+)
+from .pydantic import BaseArgs
+from .safetensors import SafeTensorsWeightsManager
+from .tracking import ExperimentsTracker, ProgressBar
+from .yaml import dump_yaml, load_yaml
+
+_DISTRIBUTED_INITIALIZED = False
+
+
+def init_distributed(timeout_minutes: int | None = None) -> None:
+    """Initialize the JAX distributed runtime for multi-host training.
+
+    Parity: reference `dolomite_engine/utils/__init__.py:28-58` (`init_distributed`) does the NCCL
+    rendezvous via `torch.distributed.init_process_group`. On TPU pods, coordination is instead
+    `jax.distributed.initialize()`, which auto-discovers the coordinator from the TPU metadata
+    (or `JAX_COORDINATOR_ADDRESS` etc. when launched manually). Single-process runs skip it.
+    """
+    global _DISTRIBUTED_INITIALIZED
+    if _DISTRIBUTED_INITIALIZED:
+        return
+
+    # heuristics: only initialize when launched as one process of a multi-process job
+    multiprocess_env = any(
+        os.environ.get(k) is not None
+        for k in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if multiprocess_env:
+        kwargs = {}
+        if timeout_minutes is not None:
+            kwargs["initialization_timeout"] = timeout_minutes * 60
+        jax.distributed.initialize(**kwargs)
+
+    _DISTRIBUTED_INITIALIZED = True
+    log_rank_0(
+        logging.INFO,
+        f"initialized JAX runtime: {jax.process_count()} process(es), {jax.device_count()} device(s)",
+    )
+
+
+def setup_tf32(use_tf32: bool = True) -> None:
+    """Parity shim for reference `utils/__init__.py:61` (`setup_tf32`). TPUs have no TF32; the
+    matching knob is the default matmul precision."""
+    jax.config.update("jax_default_matmul_precision", "tensorfloat32" if use_tf32 else "highest")

@@ -1,0 +1,87 @@
+"""Process-0 logging utilities.
+
+Parity: reference `dolomite_engine/utils/logger.py:10-57` (`set_logger`, `log_rank_0`,
+`print_rank_0`, `print_ranks_all`, `warn_rank_0`). On TPU the "rank" is `jax.process_index()`;
+there is no NCCL — sequential all-rank printing is only meaningful under multi-host, where we
+fall back to per-host prefixes (no barrier-ordered printing: XLA collectives are not usable for
+host-side side effects).
+"""
+
+import logging
+import warnings
+
+import jax
+
+_LOGGER_NAME = "dolomite_engine_tpu"
+
+
+def _process_index() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def set_logger(level: int = logging.INFO, colored_log: bool = False) -> None:
+    handler = logging.StreamHandler()
+    fmt = "%(asctime)s - [%(levelname)s]: %(message)s"
+    if colored_log:
+        try:
+            import colorlog
+
+            handler.setFormatter(colorlog.ColoredFormatter("%(log_color)s" + fmt))
+        except ImportError:
+            handler.setFormatter(logging.Formatter(fmt))
+    else:
+        handler.setFormatter(logging.Formatter(fmt))
+
+    logger = logging.getLogger(_LOGGER_NAME)
+    logger.setLevel(level)
+    logger.handlers.clear()
+    logger.addHandler(handler)
+    logger.propagate = False
+
+
+def get_logger() -> logging.Logger:
+    return logging.getLogger(_LOGGER_NAME)
+
+
+def log_rank_0(level: int, msg: str) -> None:
+    if _process_index() == 0:
+        get_logger().log(level, msg)
+
+
+def print_rank_0(*args) -> None:
+    if _process_index() == 0:
+        print(*args)
+
+
+def print_ranks_all(*args) -> None:
+    print(f"[process {_process_index()}]", *args)
+
+
+def warn_rank_0(msg: str) -> None:
+    if _process_index() == 0:
+        warnings.warn(msg)
+
+
+def run_rank_n(func, rank: int = 0, barrier: bool = False):
+    """Decorator: run `func` only on `jax.process_index() == rank`.
+
+    Parity: reference `dolomite_engine/utils/parallel.py:275-309` (`run_rank_n`). The reference
+    optionally barriers over NCCL; under JAX multi-host, callers that need a barrier should use
+    `multihost_utils.sync_global_devices` explicitly.
+    """
+
+    def wrapper(*args, **kwargs):
+        if _process_index() == rank:
+            out = func(*args, **kwargs)
+        else:
+            out = None
+        if barrier:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"run_rank_n:{getattr(func, '__name__', 'fn')}")
+        return out
+
+    return wrapper

@@ -1,0 +1,45 @@
+"""dtype string <-> jnp dtype mapping.
+
+Parity: reference `dolomite_engine/utils/mixed_precision.py:23-36` maps fp32/fp16/bf16 strings to
+torch dtypes and normalizes "fp8" specially. On TPU the natural compute dtype is bfloat16; fp8
+maps to `jnp.float8_e4m3fn` where XLA supports fp8 dots (gated at use sites).
+"""
+
+import jax.numpy as jnp
+
+_STR_TO_DTYPE = {
+    "fp32": jnp.float32,
+    "float32": jnp.float32,
+    "fp16": jnp.float16,
+    "float16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    "fp8": jnp.float8_e4m3fn,
+}
+
+_DTYPE_TO_STR = {
+    jnp.float32: "fp32",
+    jnp.float16: "fp16",
+    jnp.bfloat16: "bf16",
+    jnp.float8_e4m3fn: "fp8",
+}
+
+
+def normalize_dtype_string(dtype: str) -> str:
+    if dtype not in _STR_TO_DTYPE:
+        raise ValueError(f"unexpected dtype '{dtype}'")
+    return _DTYPE_TO_STR[_STR_TO_DTYPE[dtype]]
+
+
+def string_to_dtype(dtype: str):
+    if dtype is None:
+        return None
+    if dtype not in _STR_TO_DTYPE:
+        raise ValueError(f"unexpected dtype '{dtype}'")
+    return _STR_TO_DTYPE[dtype]
+
+
+def dtype_to_string(dtype) -> str:
+    if dtype not in _DTYPE_TO_STR:
+        raise ValueError(f"unexpected dtype '{dtype}'")
+    return _DTYPE_TO_STR[dtype]

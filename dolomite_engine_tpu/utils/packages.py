@@ -1,0 +1,36 @@
+"""Optional-package probes with warn-once semantics.
+
+Parity: reference `dolomite_engine/utils/packages.py` (is_*_available x12 for apex, deepspeed,
+flash-attn, ...). The TPU build has a much smaller optional surface: experiment trackers and
+colored logging. GPU-only deps from the reference have no probe here because their functionality
+is built in (Pallas kernels replace flash-attn/scattermoe/apex; XLA replaces torch.compile).
+"""
+
+import importlib.util
+from functools import cache
+
+
+@cache
+def _is_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def is_aim_available() -> bool:
+    return _is_available("aim")
+
+
+def is_wandb_available() -> bool:
+    return _is_available("wandb")
+
+
+def is_colorlog_available() -> bool:
+    return _is_available("colorlog")
+
+
+def is_transformers_available() -> bool:
+    return _is_available("transformers")
+
+
+def is_torch_available() -> bool:
+    # only used by HF-interop converters for reading torch-format checkpoints
+    return _is_available("torch")

@@ -1,0 +1,112 @@
+"""Lazy multi-file safetensors reader/writer (numpy-backed, no torch).
+
+Parity: reference `dolomite_engine/utils/safetensors.py:11-98` (`SafeTensorsWeightsManager`):
+lazy multi-shard reader with `get_slice` for TP sharded loading, equality compare, and sharded
+save with `model.safetensors.index.json`. Here tensors are numpy arrays; TP sharded loading on
+TPU is instead handled by Orbax/GSPMD, but `get_slice` is kept for HF-interop parity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+from safetensors import safe_open
+from safetensors.numpy import save_file
+
+_INDEX_NAME = "model.safetensors.index.json"
+_SINGLE_NAME = "model.safetensors"
+
+
+class SafeTensorsWeightsManager:
+    def __init__(self, model_path: str) -> None:
+        self.model_path = model_path
+        self.tensor_filenames: dict[str, str] = {}
+
+        index_path = os.path.join(model_path, _INDEX_NAME)
+        if os.path.isfile(index_path):
+            with open(index_path) as f:
+                index = json.load(f)
+            self.tensor_filenames = dict(index["weight_map"])
+        elif os.path.isfile(os.path.join(model_path, _SINGLE_NAME)):
+            with safe_open(os.path.join(model_path, _SINGLE_NAME), framework="np") as f:
+                for name in f.keys():
+                    self.tensor_filenames[name] = _SINGLE_NAME
+        else:
+            for fname in sorted(os.listdir(model_path)):
+                if fname.endswith(".safetensors"):
+                    with safe_open(os.path.join(model_path, fname), framework="np") as f:
+                        for name in f.keys():
+                            self.tensor_filenames[name] = fname
+
+        self._file_handles: dict[str, object] = {}
+
+    def _handle(self, tensor_name: str):
+        fname = self.tensor_filenames[tensor_name]
+        if fname not in self._file_handles:
+            self._file_handles[fname] = safe_open(
+                os.path.join(self.model_path, fname), framework="np"
+            )
+        return self._file_handles[fname]
+
+    def get_tensor(self, tensor_name: str) -> np.ndarray:
+        return self._handle(tensor_name).get_tensor(tensor_name)
+
+    def get_slice(self, tensor_name: str):
+        return self._handle(tensor_name).get_slice(tensor_name)
+
+    def get_shape(self, tensor_name: str) -> tuple[int, ...]:
+        return tuple(self.get_slice(tensor_name).get_shape())
+
+    def has_tensor(self, tensor_name: str) -> bool:
+        return tensor_name in self.tensor_filenames
+
+    def state_dict(self) -> dict[str, np.ndarray]:
+        return {name: self.get_tensor(name) for name in self.tensor_filenames}
+
+    def __len__(self) -> int:
+        return len(self.tensor_filenames)
+
+    def __iter__(self):
+        return iter(self.tensor_filenames)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, SafeTensorsWeightsManager):
+            return NotImplemented
+        if set(self.tensor_filenames) != set(other.tensor_filenames):
+            return False
+        return all(
+            np.array_equal(self.get_tensor(n), other.get_tensor(n)) for n in self.tensor_filenames
+        )
+
+    @staticmethod
+    def save_state_dict(
+        state_dict: dict[str, np.ndarray], save_path: str, max_shard_bytes: int = 5 * 2**30
+    ) -> None:
+        """Shard by size and write `model.safetensors` (+ index json when multi-shard)."""
+        os.makedirs(save_path, exist_ok=True)
+
+        shards: list[dict[str, np.ndarray]] = [{}]
+        shard_sizes = [0]
+        for name, tensor in state_dict.items():
+            nbytes = tensor.nbytes
+            if shard_sizes[-1] > 0 and shard_sizes[-1] + nbytes > max_shard_bytes:
+                shards.append({})
+                shard_sizes.append(0)
+            shards[-1][name] = np.ascontiguousarray(tensor)
+            shard_sizes[-1] += nbytes
+
+        if len(shards) == 1:
+            save_file(shards[0], os.path.join(save_path, _SINGLE_NAME))
+            return
+
+        weight_map = {}
+        for i, shard in enumerate(shards):
+            fname = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+            save_file(shard, os.path.join(save_path, fname))
+            for name in shard:
+                weight_map[name] = fname
+        index = {"metadata": {"total_size": int(sum(shard_sizes))}, "weight_map": weight_map}
+        with open(os.path.join(save_path, _INDEX_NAME), "w") as f:
+            json.dump(index, f, indent=2)

@@ -1,0 +1,137 @@
+"""Experiment tracking (aim / wandb) + progress bar, process-0 only, resumable.
+
+Parity: reference `dolomite_engine/utils/tracking.py:16-149` (`ExperimentsTracker`,
+`ProgressBar`): one class over both backends, rank-0 only, tracks scalar dicts with step and
+train/val context, logs the full arg tree, and exposes `state_dict`/`load_state_dict` storing the
+aim run-hash / wandb run id so resumed runs append to the same experiment.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+from .logger import log_rank_0
+from .packages import is_aim_available, is_wandb_available
+
+
+class ProgressBar:
+    """tqdm progress bar on process 0 only (reference `tracking.py:16-40`)."""
+
+    def __init__(self, start: int, end: int, desc: str | None = None) -> None:
+        self._bar = None
+        if jax.process_index() == 0:
+            from tqdm import tqdm
+
+            self._bar = tqdm(initial=start, total=end, desc=desc)
+
+    def update(self, n: int = 1) -> None:
+        if self._bar is not None:
+            self._bar.update(n)
+
+    def track(self, step: int) -> None:
+        if self._bar is not None:
+            self._bar.n = step
+            self._bar.refresh()
+
+
+class ExperimentsTracker:
+    def __init__(
+        self,
+        experiment_name: str | None = None,
+        tracker_name=None,
+        aim_args=None,
+        wandb_args=None,
+        checkpoint_metadata: dict | None = None,
+    ) -> None:
+        from ..enums import ExperimentsTrackerName
+
+        self.tracker_name = tracker_name
+        self.enabled = tracker_name is not None and jax.process_index() == 0
+        self.run = None
+        checkpoint_metadata = checkpoint_metadata or {}
+
+        if not self.enabled:
+            return
+
+        if tracker_name == ExperimentsTrackerName.aim:
+            if not is_aim_available():
+                log_rank_0(logging.WARNING, "aim is not installed, tracking disabled")
+                self.enabled = False
+                return
+            import aim
+
+            self.run = aim.Run(
+                run_hash=checkpoint_metadata.get("run_hash"),
+                experiment=aim_args.experiment if aim_args else experiment_name,
+                repo=aim_args.repo if aim_args else None,
+            )
+        elif tracker_name == ExperimentsTrackerName.wandb:
+            if not is_wandb_available():
+                log_rank_0(logging.WARNING, "wandb is not installed, tracking disabled")
+                self.enabled = False
+                return
+            import wandb
+
+            kwargs = {}
+            if wandb_args is not None:
+                kwargs = {
+                    "project": wandb_args.project,
+                    "name": wandb_args.name,
+                    "entity": wandb_args.entity,
+                }
+            run_id = checkpoint_metadata.get("run_id")
+            if run_id is not None:
+                kwargs.update({"id": run_id, "resume": "must"})
+            self.run = wandb.init(**kwargs)
+        else:
+            raise ValueError(f"unexpected tracker {tracker_name}")
+
+    def log_args(self, args) -> None:
+        """Log the full (flattened) config tree (reference `tracking.py:72-93`)."""
+        if not self.enabled or self.run is None:
+            return
+        flat = _flatten(args.to_dict())
+        from ..enums import ExperimentsTrackerName
+
+        if self.tracker_name == ExperimentsTrackerName.aim:
+            for k, v in flat.items():
+                self.run[k] = v
+        else:
+            self.run.config.update(flat, allow_val_change=True)
+
+    def track(self, values: dict[str, Any], step: int | None = None, context: str | None = None) -> None:
+        if not self.enabled or self.run is None:
+            return
+        from ..enums import ExperimentsTrackerName
+
+        if self.tracker_name == ExperimentsTrackerName.aim:
+            for key, value in values.items():
+                self.run.track(value, name=key, step=step, context={"subset": context})
+        else:
+            prefix = f"{context}/" if context else ""
+            self.run.log({f"{prefix}{k}": v for k, v in values.items()}, step=step)
+
+    def finish(self) -> None:
+        if not self.enabled or self.run is None:
+            return
+        from ..enums import ExperimentsTrackerName
+
+        if self.tracker_name == ExperimentsTrackerName.wandb:
+            self.run.finish()
+        else:
+            self.run.close()
+
+    def state_dict(self) -> dict:
+        """Resumability (reference `tracking.py:131-149`)."""
+        state = {}
+        if self.run is not None:
+            from ..enums import ExperimentsTrackerName
+
+            if self.tracker_name == ExperimentsTrackerName.aim:
+                state["run_hash"] = self.run.hash
+            else:
+                state["run_id"] = self.run.id
+        return state

@@ -1,0 +1,37 @@
+"""YAML loading with correct float resolution.
+
+Parity: reference `dolomite_engine/utils/yaml.py:6-24` patches SafeLoader so scientific notation
+like ``1e-5`` (no dot, no sign after e) resolves to float instead of str.
+"""
+
+import re
+
+import yaml
+
+_FLOAT_RESOLVER = re.compile(
+    """^(?:
+     [-+]?(?:[0-9][0-9_]*)\\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+    |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+    |\\.[0-9_]+(?:[eE][-+][0-9]+)?
+    |[-+]?[0-9][0-9_]*(?::[0-5]?[0-9])+\\.[0-9_]*
+    |[-+]?\\.(?:inf|Inf|INF)
+    |\\.(?:nan|NaN|NAN))$""",
+    re.X,
+)
+
+
+class _Loader(yaml.SafeLoader):
+    pass
+
+
+_Loader.add_implicit_resolver("tag:yaml.org,2002:float", _FLOAT_RESOLVER, list("-+0123456789."))
+
+
+def load_yaml(path: str) -> dict:
+    with open(path, "r") as f:
+        return yaml.load(f, _Loader)
+
+
+def dump_yaml(obj: dict, path: str) -> None:
+    with open(path, "w") as f:
+        yaml.safe_dump(obj, f, sort_keys=False)

@@ -1,0 +1,67 @@
+"""Test harness setup.
+
+Tests run on a virtual 8-device CPU mesh (`--xla_force_host_platform_device_count=8`), which
+makes TP/FSDP/SP logic single-process unit-testable — strictly stronger than the reference's
+torchrun-subprocess multi-GPU tests (SURVEY §4).
+
+The axon TPU plugin registers itself from sitecustomize in every interpreter and hangs CPU-only
+processes at the first computation (it tries to claim the single tunneled chip). Env vars must be
+set before interpreter start, so this conftest re-execs pytest once with a clean CPU env unless
+the caller already did (or explicitly wants TPU tests via DOLOMITE_TPU_TESTS_ON_TPU=1).
+"""
+
+import os
+import sys
+
+if (
+    os.environ.get("PALLAS_AXON_POOL_IPS")
+    and not os.environ.get("DOLOMITE_TPU_TESTS_ON_TPU")
+    and os.environ.get("_DOLOMITE_CPU_REEXEC") != "1"
+):
+    env = dict(os.environ)
+    env["_DOLOMITE_CPU_REEXEC"] = "1"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    xla_flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        env["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices
+
+
+@pytest.fixture()
+def mesh_2x2x2(eight_devices):
+    """(dp=2, fsdp=2, tp=2) mesh for distributed-logic tests."""
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    MeshManager(
+        tensor_parallel_size=2,
+        data_parallel_replication_world_size=2,
+        data_parallel_sharding_world_size=2,
+    )
+    yield MeshManager.get_mesh()
+    MeshManager.destroy()
+
+
+@pytest.fixture()
+def mesh_fsdp8(eight_devices):
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    MeshManager()
+    yield MeshManager.get_mesh()
+    MeshManager.destroy()

@@ -1,0 +1,172 @@
+"""Unit tests for ops: activations, norms, rope/yarn, alibi, packing, loss, schedules.
+
+Parity: reference `tests/hf_models/single_gpu/normalization_test.py`, `activations_test.py`.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.enums import LRDecaySchedule
+from dolomite_engine_tpu.ops.activations import get_activation_function, is_glu
+from dolomite_engine_tpu.ops.alibi import get_alibi_slopes
+from dolomite_engine_tpu.ops.loss import causal_lm_loss, cross_entropy_loss
+from dolomite_engine_tpu.ops.normalization import layernorm, rmsnorm
+from dolomite_engine_tpu.ops.packing import (
+    cu_seqlens_to_segment_ids,
+    pack_sequences,
+    segment_ids_from_eos,
+    segment_ids_to_cu_seqlens,
+)
+from dolomite_engine_tpu.ops.rope import RoPEParams, apply_rotary_pos_emb, get_cos_sin
+from dolomite_engine_tpu.optimization.scheduler import get_scheduler_factor
+
+from ..test_commons import assert_allclose
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["gelu", "gelu_pytorch_tanh", "relu", "silu", "swish", "mish", "tanh", "relu2", "laplace"],
+)
+def test_base_activations_match_torch(name):
+    import torch
+    from transformers.activations import ACT2FN
+
+    torch_map = {
+        "gelu": torch.nn.GELU(),
+        "gelu_pytorch_tanh": torch.nn.GELU(approximate="tanh"),
+        "relu": torch.nn.ReLU(),
+        "silu": torch.nn.SiLU(),
+        "swish": torch.nn.SiLU(),
+        "mish": torch.nn.Mish(),
+        "tanh": torch.nn.Tanh(),
+        "relu2": ACT2FN["relu2"],
+        "laplace": ACT2FN["laplace"],
+    }
+    x = np.linspace(-4, 4, 101).astype(np.float32)
+    ours = np.asarray(get_activation_function(name)(jnp.asarray(x)))
+    theirs = torch_map[name](torch.from_numpy(x)).numpy()
+    assert_allclose(ours, theirs, atol=1e-5, rtol=1e-5)
+
+
+def test_glu_chunk_order():
+    # GLU: first chunk is up, second is gated (reference glu.py forward: x[0] * act(x[1]))
+    x = jnp.asarray(np.concatenate([np.full(4, 3.0), np.full(4, -100.0)]).astype(np.float32))
+    out = get_activation_function("swiglu")(x)
+    # silu(-100) ~ 0 -> output ~ 0 (up=3 * act(gate=-100))
+    assert float(jnp.max(jnp.abs(out))) < 1e-4
+    assert is_glu("swiglu") and is_glu("glu") and not is_glu("gelu")
+
+
+def test_norms_match_torch():
+    import torch
+
+    x = np.random.RandomState(0).randn(3, 17).astype(np.float32)
+    w = np.random.RandomState(1).rand(17).astype(np.float32)
+    b = np.random.RandomState(2).randn(17).astype(np.float32)
+
+    ln_ref = torch.nn.functional.layer_norm(
+        torch.from_numpy(x), (17,), torch.from_numpy(w), torch.from_numpy(b), 1e-5
+    ).numpy()
+    assert_allclose(layernorm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), 1e-5), ln_ref, atol=1e-5)
+
+    rms_ref = torch.from_numpy(x) * torch.rsqrt(
+        torch.from_numpy(x).pow(2).mean(-1, keepdim=True) + 1e-6
+    )
+    rms_ref = (rms_ref * torch.from_numpy(w)).numpy()
+    assert_allclose(rmsnorm(jnp.asarray(x), jnp.asarray(w), 1e-6), rms_ref, atol=1e-5)
+
+
+def test_rope_rotation_preserves_norm_and_relative_positions():
+    rope = RoPEParams.from_config(head_dim=16, base=10000)
+    pos = jnp.arange(8)[None]
+    cos, sin = get_cos_sin(rope, pos)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 8, 2, 16).astype(np.float32))
+    rx = apply_rotary_pos_emb(x, cos, sin)
+    assert_allclose(
+        jnp.linalg.norm(rx, axis=-1), jnp.linalg.norm(x, axis=-1), atol=1e-4, rtol=1e-4
+    )
+    # relative property: <R(p)q, R(p+k)v> depends only on k
+    q = x[:, :1]
+    dots = []
+    for p in range(4):
+        cq, sq = get_cos_sin(rope, jnp.asarray([[p]]))
+        ck, sk = get_cos_sin(rope, jnp.asarray([[p + 3]]))
+        rq = apply_rotary_pos_emb(q, cq, sq)
+        rk = apply_rotary_pos_emb(q, ck, sk)
+        dots.append(float(jnp.sum(rq * rk)))
+    assert max(dots) - min(dots) < 1e-3
+
+
+def test_yarn_mscale_and_inv_freq():
+    plain = RoPEParams.from_config(head_dim=16, base=10000)
+    yarn = RoPEParams.from_config(
+        head_dim=16,
+        base=10000,
+        rope_scaling={"type": "yarn", "factor": 4.0, "original_max_position_embeddings": 128},
+        max_position_embeddings=512,
+    )
+    assert yarn.mscale == pytest.approx(0.1 * math.log(4.0) + 1.0)
+    # interpolated freqs are slower (smaller) than plain, never faster
+    assert np.all(yarn.inv_freq <= plain.inv_freq + 1e-9)
+
+
+def test_alibi_slopes_non_pow2():
+    s8 = get_alibi_slopes(8)
+    assert s8.shape == (8,)
+    assert_allclose(s8[0], 2 ** (-8 / 8.0 * 1), atol=1e-6)
+    s6 = get_alibi_slopes(6)  # non-power-of-2 head count extension
+    assert s6.shape == (6,) and np.all(s6 > 0)
+
+
+def test_packing_roundtrip():
+    packed = pack_sequences([[5, 6, 7], [8, 9]], max_length=8, pad_token_id=0)
+    assert packed["segment_ids"].tolist() == [[1, 1, 1, 2, 2, 0, 0, 0]]
+    assert packed["position_ids"].tolist() == [[0, 1, 2, 0, 1, 0, 0, 0]]
+    cu = segment_ids_to_cu_seqlens(packed["segment_ids"])
+    assert cu.tolist() == [0, 3, 5]
+    seg = cu_seqlens_to_segment_ids(cu, 8)
+    assert seg.tolist() == [1, 1, 1, 2, 2, 0, 0, 0]
+
+
+def test_segment_ids_from_eos():
+    tokens = np.asarray([[3, 4, 1, 5, 6, 7, 1, 8]])  # eos = 1
+    seg, pos = segment_ids_from_eos(tokens, eos_token_id=1)
+    assert seg.tolist() == [[1, 1, 1, 2, 2, 2, 2, 3]]
+    assert pos.tolist() == [[0, 1, 2, 0, 1, 2, 3, 0]]
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.asarray(np.random.RandomState(0).randn(2, 4, 10).astype(np.float32))
+    labels = jnp.asarray([[1, 2, -100, 3], [-100, -100, 5, 6]])
+    loss_sum, n = cross_entropy_loss(logits, labels)
+    assert int(n) == 5
+    full = causal_lm_loss(logits, jnp.zeros((2, 4), jnp.int32), labels=labels)
+    assert_allclose(full, loss_sum / n)
+
+
+@pytest.mark.parametrize(
+    "style", [LRDecaySchedule.constant, LRDecaySchedule.cosine, LRDecaySchedule.linear, LRDecaySchedule.exponential]
+)
+def test_scheduler_boundaries(style):
+    f = get_scheduler_factor(10, 5, None, 100, style, 0.1)
+    assert float(f(0)) == pytest.approx(0.0)
+    assert float(f(10)) == pytest.approx(1.0)
+    assert float(f(12)) == pytest.approx(1.0)
+    if style != LRDecaySchedule.constant:
+        assert float(f(100)) == pytest.approx(0.1, abs=1e-5)
+        assert float(f(50)) < 1.0
+    else:
+        assert float(f(100)) == pytest.approx(1.0)
+
+
+def test_power_scheduler():
+    f = get_scheduler_factor(
+        10, 0, None, 100, LRDecaySchedule.power, 0.1,
+        extra_lr_scheduler_args={"a": 1e-2, "b": -0.51, "c": 512}, base_lr=1e-3,
+    )
+    assert float(f(5)) <= float(f(10)) <= 1.0
+    assert float(f(50)) <= 1.0

@@ -1,0 +1,90 @@
+"""Shared test fixtures.
+
+Parity: reference `tests/hf_models/test_common.py` (`TestCommons.get_dense_test_config`,
+`get_moe_test_config`, `get_dummy_inputs`, `assert_equal_tensors`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dolomite_engine_tpu.models.config import CommonConfig, MoEConfig
+
+SEED = 42
+
+
+def get_dense_test_config(
+    attention_head_type: str = "mqa",
+    position_embedding_type: str = "learned_absolute",
+    num_layers: int = 4,
+    add_bias: bool = True,
+    activation_function: str = "gelu_pytorch_tanh",
+    normalization_function: str = "layernorm",
+    **kwargs,
+) -> CommonConfig:
+    num_kv = {"mha": None, "mqa": None, "gqa": 2}[attention_head_type]
+    return CommonConfig(
+        vocab_size=2048,
+        n_positions=512,
+        n_embd=32,
+        n_layer=num_layers,
+        n_head=4,
+        num_key_value_heads=num_kv,
+        attention_head_type=attention_head_type,
+        position_embedding_type=position_embedding_type,
+        add_bias=add_bias,
+        activation_function=activation_function,
+        normalization_function=normalization_function,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        bos_token_id=0,
+        eos_token_id=1,
+        pad_token_id=2,
+        **kwargs,
+    )
+
+
+def get_moe_test_config(
+    attention_head_type: str = "mqa",
+    position_embedding_type: str = "learned_absolute",
+    num_experts: int = 4,
+    num_experts_per_tok: int = 2,
+    **kwargs,
+) -> MoEConfig:
+    num_kv = {"mha": None, "mqa": None, "gqa": 2}[attention_head_type]
+    return MoEConfig(
+        vocab_size=2048,
+        n_positions=512,
+        n_embd=32,
+        n_layer=4,
+        n_head=4,
+        num_key_value_heads=num_kv,
+        attention_head_type=attention_head_type,
+        position_embedding_type=position_embedding_type,
+        num_experts=num_experts,
+        num_experts_per_tok=num_experts_per_tok,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        bos_token_id=0,
+        eos_token_id=1,
+        pad_token_id=2,
+        **kwargs,
+    )
+
+
+def get_dummy_inputs(config, batch: int = 2, seq: int = 16, padded: bool = True):
+    rs = np.random.RandomState(SEED)
+    input_ids = rs.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    attention_mask = None
+    if padded:
+        attention_mask = np.ones((batch, seq), np.int32)
+        attention_mask[0, : seq // 4] = 0  # left padding on row 0
+    return jnp.asarray(input_ids), None if attention_mask is None else jnp.asarray(attention_mask)
+
+
+def assert_allclose(a, b, atol=1e-5, rtol=1e-5, msg=""):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol, rtol=rtol, err_msg=msg)

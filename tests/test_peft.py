@@ -1,0 +1,110 @@
+"""PEFT tests: LoRA adapter creation/freezing, prompt tuning forward.
+
+Parity: reference wraps with HF peft (`model_wrapper/peft.py`); here we assert the JAX-native
+equivalents: adapters exist, base output is unchanged at init (lora_b = 0), trainable mask
+freezes base weights, prompt tuning prepends virtual tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.peft import peft_trainable_mask
+from dolomite_engine_tpu.peft.lora import LoRACausalLM
+from dolomite_engine_tpu.peft.prompt_tuning import PromptTuningCausalLM
+
+from .test_commons import assert_allclose, get_dense_test_config, get_dummy_inputs
+
+
+def test_lora_zero_init_preserves_base_output_and_freezes_base():
+    config = get_dense_test_config("mqa", "rope", num_layers=2)
+    base = GPTDolomiteForCausalLM(config=config)
+    lora = LoRACausalLM(base_model=base, rank=4, alpha=8.0, dropout=0.0)
+
+    ids, _ = get_dummy_inputs(config, padded=False)
+    lora_vars = lora.init(jax.random.PRNGKey(0), ids)
+
+    attn = lora_vars["params"]["base_model"]["transformer"]["h_0"]["attn"]["c_attn"]
+    assert "lora_a" in attn and "lora_b" in attn
+    assert attn["lora_a"].value.shape == (config.n_embd, 4)
+    assert float(jnp.abs(attn["lora_b"].value).max()) == 0.0  # zero init
+
+    # lora_b = 0 -> output identical to the base model with the same base weights
+    base_vars = {"params": lora_vars["params"]["base_model"]}
+
+    def strip_lora(tree):
+        if isinstance(tree, dict):
+            return {k: strip_lora(v) for k, v in tree.items() if k not in ("lora_a", "lora_b")}
+        return tree
+
+    base_out = base.apply({"params": strip_lora(base_vars["params"])}, ids)
+    lora_out = lora.apply(lora_vars, ids)
+    assert_allclose(base_out.logits, lora_out.logits, atol=1e-6)
+
+    mask = peft_trainable_mask(lora_vars["params"])
+    leaves = jax.tree_util.tree_leaves_with_path(mask)
+    trainable = [jax.tree_util.keystr(p) for p, v in leaves if v]
+    frozen = [jax.tree_util.keystr(p) for p, v in leaves if not v]
+    assert all("lora" in p for p in trainable) and len(trainable) == 2 * config.n_layer
+    assert any("wte" in p for p in frozen)
+
+
+def test_lora_nonzero_b_changes_output():
+    config = get_dense_test_config("mqa", "rope", num_layers=2)
+    base = GPTDolomiteForCausalLM(config=config)
+    lora = LoRACausalLM(base_model=base, rank=4, alpha=8.0, dropout=0.0)
+    ids, _ = get_dummy_inputs(config, padded=False)
+    variables = lora.init(jax.random.PRNGKey(0), ids)
+    out0 = lora.apply(variables, ids)
+
+    bumped = jax.tree.map(lambda x: x, variables)
+    params = bumped["params"]["base_model"]["transformer"]["h_0"]["attn"]["c_attn"]
+    params["lora_b"] = params["lora_b"].replace_boxed(params["lora_b"].value + 0.05)
+    out1 = lora.apply(bumped, ids)
+    assert float(jnp.abs(out1.logits - out0.logits).max()) > 1e-4
+
+
+def test_freeze_base_weights_zeroes_frozen_updates():
+    """Regression: optax.masked passes masked-out grads through UNCHANGED — freezing must use
+    multi_transform + set_to_zero (caught live: base wte drifted and loss diverged)."""
+    import optax
+
+    from dolomite_engine_tpu.peft import freeze_base_weights
+
+    config = get_dense_test_config("mqa", "rope", num_layers=1)
+    base = GPTDolomiteForCausalLM(config=config)
+    lora = LoRACausalLM(base_model=base, rank=2, alpha=4.0, dropout=0.0)
+    ids, _ = get_dummy_inputs(config, padded=False)
+    params = lora.init(jax.random.PRNGKey(0), ids)["params"]
+
+    opt = freeze_base_weights(optax.adamw(0.1), params)
+    state = opt.init(params)
+    grads = jax.tree.map(lambda x: jnp.ones_like(x), params)
+    updates, _ = opt.update(grads, state, params)
+
+    wte_update = updates["base_model"]["transformer"]["wte"]["embedding"].value
+    lora_update = updates["base_model"]["transformer"]["h_0"]["attn"]["c_attn"]["lora_a"].value
+    assert float(jnp.abs(wte_update).max()) == 0.0
+    assert float(jnp.abs(lora_update).max()) > 0.0
+
+
+def test_prompt_tuning_forward_and_mask():
+    config = get_dense_test_config("mqa", "rope", num_layers=2)
+    base = GPTDolomiteForCausalLM(config=config)
+    pt = PromptTuningCausalLM(base_model=base, num_virtual_tokens=5)
+
+    ids, mask = get_dummy_inputs(config)
+    labels = np.asarray(ids).copy().astype(np.int32)
+    variables = pt.init(jax.random.PRNGKey(0), ids, attention_mask=mask, labels=jnp.asarray(labels))
+    out = pt.apply(variables, ids, attention_mask=mask, labels=jnp.asarray(labels))
+
+    assert out.logits.shape == (ids.shape[0], ids.shape[1] + 5, config.vocab_size)
+    assert np.isfinite(float(out.loss))
+    assert "prompt_embeddings" in variables["params"]
+
+    tmask = peft_trainable_mask(variables["params"])
+    trainable = [
+        jax.tree_util.keystr(p) for p, v in jax.tree_util.tree_leaves_with_path(tmask) if v
+    ]
+    assert trainable and all("prompt_embeddings" in p for p in trainable)
