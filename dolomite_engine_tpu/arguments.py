@@ -248,7 +248,7 @@ class DatasetArgs(BaseArgs):
     # formatting to use for output
     output_format: str = OUTPUT_FORMAT
     # data sampling proportions
-    data_sampling_ratio: int = None
+    data_sampling_ratio: int | None = None
     # max tokens for input text
     max_input_tokens: int | None = None
     # max tokens for output text
@@ -561,6 +561,11 @@ _MODE_ARGS_MAP = {
 }
 
 
+def args_from_dict(config: dict, mode: Mode):
+    """Build the per-mode args tree from an already-loaded dict (checkpoint config snapshots)."""
+    return _MODE_ARGS_MAP[mode](**config)
+
+
 def get_args(mode: Mode, config_path: str | None = None):
     """Parse `--config path.yml` (or an explicit path) into the per-mode args tree."""
     if config_path is None:
@@ -569,7 +574,7 @@ def get_args(mode: Mode, config_path: str | None = None):
         config_path = parser.parse_args().config
 
     config: dict = load_yaml(config_path)
-    args = _MODE_ARGS_MAP[mode](**config)
+    args = args_from_dict(config, mode)
 
     set_logger(
         getattr(logging, args.logging_args.logging_level),

@@ -56,7 +56,7 @@ def get_state_shardings(
         opt_state = optimizer.init(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
-    abstract_state = jax.eval_shape(_abstract_init)
+    abstract_state = jax.eval_shape(_abstract_init)  # boxed: needed for partition-spec derivation
     logical_specs = nn.get_partition_spec(abstract_state)
 
     param_shardings = logical_to_mesh_sharding(
@@ -86,7 +86,7 @@ def create_sharded_train_state(
 
     def _init():
         variables = model.model.init(rng, **model.get_dummy_inputs())
-        params = variables["params"]
+        params = nn.unbox(variables["params"])  # runtime trees are unboxed (orbax-serializable)
         opt_state = optimizer.init(params)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 

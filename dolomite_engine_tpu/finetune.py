@@ -1,0 +1,288 @@
+"""Finetuning trainer entry point: `python -m dolomite_engine_tpu.finetune --config cfg.yml`.
+
+Parity: reference `dolomite_engine/finetune.py` (315 LoC): `main` (214-311) builds args ->
+distributed init -> model -> dataloaders -> wrap -> optimizer/scheduler -> resume -> train;
+`train` (49-153) loops `infinite_iterator(train_dataloader)` for num_training_steps with
+periodic eval/save; `evaluate` (156-211) is a full pass over the val loader.
+
+TPU deltas: the train step is ONE jitted function over the whole global-step batch (micro-batch
+grad accumulation via `lax.scan` inside, see `train_utils.make_train_step`); there is no
+torch-profiler/no_sync/clip plumbing in the loop body — those live inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .arguments import TrainingArgs, get_args
+from .checkpointing import (
+    get_experiments_tracker_checkpoint_metadata,
+    load_checkpoint_for_training,
+    save_checkpoint,
+)
+from .data import get_dataloader, infinite_iterator
+from .distributed import build_mesh_from_args, create_sharded_train_state
+from .enums import DatasetSplit, Mode, TuningMethod
+from .model_wrapper import get_model, log_model
+from .optimization import get_optimizer, get_scheduler
+from .train_utils import (
+    get_profiler_context,
+    make_eval_step,
+    make_train_step,
+    track_train_metrics,
+)
+from .utils import (
+    ExperimentsTracker,
+    ProgressBar,
+    init_distributed,
+    log_rank_0,
+    setup_tf32,
+)
+
+
+def build_optimizer_from_args(args: TrainingArgs, model):
+    lr_scheduler_args = args.lr_scheduler_args
+    lr_schedule = get_scheduler(
+        num_warmup_steps=lr_scheduler_args.num_warmup_steps,
+        num_constant_steps=lr_scheduler_args.num_constant_steps,
+        num_decay_steps=lr_scheduler_args.num_decay_steps,
+        num_training_steps=args.training_parameters.num_training_steps,
+        lr_decay_style=lr_scheduler_args.lr_decay_style,
+        lr_decay_factor=lr_scheduler_args.lr_decay_factor,
+        extra_lr_scheduler_args=lr_scheduler_args.extra_lr_scheduler_args,
+        base_lr=args.optimizer_args.class_args.get("lr", 1e-5),
+    )
+    optimizer = get_optimizer(
+        optimizer_class_name=args.optimizer_args.class_name,
+        optimizer_class_args=args.optimizer_args.class_args,
+        lr_schedule=lr_schedule,
+        params_group_method=args.optimizer_args.params_group_method,
+        model_config=model.config,
+        params=model.abstract_params(),
+    )
+    return optimizer, lr_schedule
+
+
+def _stack_micro_batches(batches: list[dict]) -> dict:
+    """[grad_accum] leading axis on every leaf (all micro-batches of one global step)."""
+    out = {}
+    for k in batches[0].keys():
+        vals = [b[k] for b in batches]
+        if vals[0] is None:
+            continue
+        out[k] = jnp.stack(vals)
+    return out
+
+
+def train(
+    args: TrainingArgs,
+    model,
+    state,
+    optimizer,
+    lr_schedule,
+    train_dataloader,
+    val_dataloader,
+    experiments_tracker: ExperimentsTracker | None,
+    starting_iteration: int = 0,
+    jax_rng: jax.Array | None = None,
+) -> None:
+    """Main finetuning loop (reference `finetune.py:49-153`)."""
+    num_training_steps = args.training_parameters.num_training_steps
+    gradient_accumulation_steps = args.training_parameters.gradient_accumulation_steps
+    eval_during_training = args.training_parameters.eval_during_training
+    eval_interval = args.training_parameters.eval_interval
+    save_interval = args.save_args.save_interval
+    log_interval = args.logging_args.log_interval
+
+    def loss_fn(params, micro_batch, rng):
+        rngs = None if rng is None else {"dropout": rng, "neft": rng}
+        return model.loss(params, micro_batch, rngs=rngs, train=True)
+
+    train_step = jax.jit(
+        make_train_step(
+            loss_fn,
+            optimizer,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+            gradient_clipping=args.training_parameters.gradient_clipping,
+        ),
+        donate_argnums=(0,),
+    )
+    eval_step = jax.jit(
+        make_eval_step(lambda params, batch, rng: model.loss(params, batch, rngs=None, train=False))
+    )
+
+    if jax_rng is None:
+        jax_rng = jax.random.PRNGKey(args.random_args.seed)
+
+    if eval_during_training and starting_iteration == 0:
+        evaluate(val_dataloader, model, state, starting_iteration, experiments_tracker, eval_step)
+
+    micro_batches_per_step = gradient_accumulation_steps
+    batch_iter = infinite_iterator(train_dataloader)
+
+    loss_running_sum, loss_running_count = 0.0, 0
+    progress = ProgressBar(starting_iteration, num_training_steps)
+
+    global_step = starting_iteration
+    while global_step < num_training_steps:
+        global_step += 1
+        step_start = time.perf_counter()
+
+        micro_batches = [next(batch_iter) for _ in range(micro_batches_per_step)]
+        batch = _stack_micro_batches(micro_batches)
+
+        jax_rng, step_rng = jax.random.split(jax_rng)
+        with get_profiler_context(
+            args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
+        ):
+            state, metrics = train_step(state, batch, step_rng)
+
+        if global_step % log_interval == 0:
+            loss = float(metrics["loss"])
+            loss_running_sum += loss
+            loss_running_count += 1
+            track_train_metrics(
+                global_step=global_step,
+                train_loss_step=loss,
+                grad_norm=float(metrics["grad_norm"]),
+                current_lr=float(lr_schedule(global_step)),
+                experiments_tracker=experiments_tracker,
+                loss_running_mean=loss_running_sum / max(loss_running_count, 1),
+                step_time=time.perf_counter() - step_start,
+            )
+
+        progress.track(global_step)
+
+        if eval_during_training and eval_interval and global_step % eval_interval == 0:
+            evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
+
+        if global_step % save_interval == 0 or global_step == num_training_steps:
+            save_checkpoint(
+                args,
+                model,
+                state,
+                train_dataloader,
+                experiments_tracker,
+                global_step,
+                jax_rng=jax_rng,
+            )
+
+    if eval_during_training:
+        evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
+
+
+def evaluate(
+    val_dataloader,
+    model,
+    state,
+    global_step: int,
+    experiments_tracker: ExperimentsTracker | None,
+    eval_step=None,
+) -> float | None:
+    """Full pass over the val loader (reference `finetune.py:156-211`). Pass a pre-jitted
+    `eval_step` to avoid recompiling on every eval interval."""
+    if val_dataloader is None:
+        return None
+
+    if eval_step is None:
+        eval_step = jax.jit(
+            make_eval_step(
+                lambda params, batch, rng: model.loss(params, batch, rngs=None, train=False)
+            )
+        )
+
+    loss_sum, count = 0.0, 0
+    for batch in val_dataloader:
+        batch = {k: v for k, v in batch.items() if v is not None}
+        loss_sum += float(eval_step(state.params, batch))
+        count += 1
+    if count == 0:
+        return None
+
+    loss = loss_sum / count
+    if experiments_tracker is not None:
+        experiments_tracker.track({"loss": loss}, step=global_step, context="val")
+    log_rank_0(logging.INFO, f"step = {global_step}, val loss = {loss:.4f}")
+    return loss
+
+
+def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
+    """Reference `finetune.py:214-311`."""
+    setup_tf32()
+
+    if args is None:
+        args = get_args(mode)
+
+    assert args.tuning_args.tuning_method in (
+        TuningMethod.full_finetuning,
+        TuningMethod.prompt_tuning,
+        TuningMethod.lora,
+    ), "finetune requires a finetuning tuning method"
+
+    init_distributed(timeout_minutes=args.distributed_args.timeout_minutes)
+
+    import transformers
+
+    transformers.set_seed(args.random_args.seed)
+    np.random.seed(args.random_args.seed)
+
+    model = get_model(args, mode)
+    log_model(model)
+
+    mesh = build_mesh_from_args(args)
+
+    train_dataloader = get_dataloader(
+        args, DatasetSplit.train, mode, model.tokenizer, mesh=mesh
+    )
+    val_dataloader = None
+    if args.training_parameters.eval_during_training:
+        val_dataloader = get_dataloader(
+            args, DatasetSplit.val, mode, model.tokenizer, mesh=mesh
+        )
+
+    optimizer, lr_schedule = build_optimizer_from_args(args, model)
+
+    rng = jax.random.PRNGKey(args.random_args.seed)
+    state, _ = create_sharded_train_state(model, optimizer, mesh, rng)
+
+    starting_iteration = 0
+    metadata = None
+    jax_rng = None
+    if args.load_args is not None:
+        state, starting_iteration, metadata, jax_rng = load_checkpoint_for_training(
+            args, state, train_dataloader, experiments_tracker=None
+        )
+
+    experiments_tracker = ExperimentsTracker(
+        experiment_name="dolomite-tpu-finetune",
+        tracker_name=args.logging_args.experiments_tracker_name,
+        aim_args=args.logging_args.aim_args,
+        wandb_args=args.logging_args.wandb_args,
+        checkpoint_metadata=get_experiments_tracker_checkpoint_metadata(args),
+    )
+    experiments_tracker.log_args(args)
+
+    with mesh:
+        train(
+            args,
+            model,
+            state,
+            optimizer,
+            lr_schedule,
+            train_dataloader,
+            val_dataloader,
+            experiments_tracker,
+            starting_iteration=starting_iteration,
+            jax_rng=jax_rng,
+        )
+
+    experiments_tracker.finish()
+
+
+if __name__ == "__main__":
+    main()

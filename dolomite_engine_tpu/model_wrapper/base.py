@@ -127,15 +127,20 @@ class ModelWrapper:
     def get_dummy_inputs(self) -> dict:
         return {"input_ids": jnp.zeros((1, 8), jnp.int32)}
 
-    def abstract_params(self):
-        """Shape/dtype tree without allocating (reference's meta-device init, base.py:210-230)."""
+    def abstract_boxed_params(self):
+        """Shape/dtype tree with flax Partitioned boxes (for logical-spec derivation)."""
         return jax.eval_shape(
             lambda: self.model.init(jax.random.PRNGKey(0), **self.get_dummy_inputs())
         )["params"]
 
+    def abstract_params(self):
+        """Unboxed shape/dtype tree (reference's meta-device init, base.py:210-230). Runtime
+        param trees are always unboxed — sharding metadata lives in the sharding rules, and
+        unboxed trees serialize cleanly (orbax)."""
+        return nn.unbox(self.abstract_boxed_params())
+
     def logical_specs(self):
-        variables = self.abstract_params()
-        return nn.get_partition_spec({"params": variables})["params"]
+        return nn.get_partition_spec({"params": self.abstract_boxed_params()})["params"]
 
     def sharding_rules(self, for_optimizer: bool = False) -> LogicalRules:
         return get_logical_axis_rules(
@@ -156,7 +161,7 @@ class ModelWrapper:
         shardings = self.param_shardings(mesh)
 
         def _init():
-            return self.model.init(rng, **self.get_dummy_inputs())["params"]
+            return nn.unbox(self.model.init(rng, **self.get_dummy_inputs())["params"])
 
         with mesh:
             return jax.jit(_init, out_shardings=shardings)()

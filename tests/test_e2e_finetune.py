@@ -1,0 +1,132 @@
+"""End-to-end finetune -> checkpoint -> resume -> unshard on the virtual CPU mesh.
+
+Parity: reference's (commented-out) dcp e2e test `tests/hf_models/multi_gpu/dcp/dcp_test.py` —
+strictly stronger here: runs fully in-process on the 8-device mesh.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.arguments import TrainingArgs, UnshardingArgs
+from dolomite_engine_tpu.enums import Mode
+
+
+class _StubTokenizer:
+    eos_token_id = 1
+    pad_token_id = 2
+    vocab_size = 128
+
+    def __call__(self, text, add_special_tokens=False):
+        return {"input_ids": [ord(c) % 100 for c in str(text)]}
+
+    def __len__(self):
+        return self.vocab_size
+
+    def save_pretrained(self, path):
+        pass
+
+
+def _training_args(tmp_path, num_steps=3, load_path=None) -> TrainingArgs:
+    cfg = dict(
+        model_args=dict(
+            model_class="AutoModelForCausalLM",
+            pretrained_config=dict(
+                model_type="gpt_dolomite",
+                vocab_size=128,
+                n_positions=64,
+                n_embd=32,
+                n_layer=2,
+                n_head=4,
+                attention_head_type="mha",
+                position_embedding_type="rope",
+                activation_function="swiglu",
+                normalization_function="rmsnorm",
+                add_bias=False,
+                resid_pdrop=0.0,
+                embd_pdrop=0.0,
+                attn_pdrop=0.0,
+                bos_token_id=0,
+                eos_token_id=1,
+                pad_token_id=2,
+            ),
+        ),
+        tuning_args=dict(tuning_method="full_finetuning"),
+        training_parameters=dict(
+            num_training_steps=num_steps,
+            micro_batch_size=8,
+            gradient_accumulation_steps=2,
+            eval_during_training=False,
+        ),
+        datasets=[
+            dict(
+                class_name="DebugDataset",
+                data_name="debug",
+                class_args=dict(num_examples=64),
+                max_input_tokens=8,
+                max_output_tokens=8,
+            )
+        ],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=2),
+        logging_args=dict(log_interval=1),
+        random_args=dict(seed=7),
+    )
+    if load_path is not None:
+        cfg["load_args"] = dict(load_path=load_path)
+    return TrainingArgs(**cfg)
+
+
+@pytest.fixture()
+def stub_tokenizer(monkeypatch):
+    from dolomite_engine_tpu.model_wrapper import base as mw_base
+
+    def _setup(self, tokenizer_name, additional_special_tokens):
+        self.tokenizer = _StubTokenizer()
+
+    monkeypatch.setattr(mw_base.ModelWrapper, "_setup_tokenizer", _setup)
+
+
+def test_finetune_save_resume_unshard(tmp_path, stub_tokenizer, eight_devices):
+    from dolomite_engine_tpu import finetune, unshard
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    MeshManager.destroy()
+    args = _training_args(tmp_path, num_steps=3)
+    finetune.main(args=args)
+
+    ckpt_root = tmp_path / "ckpt"
+    latest = ckpt_root / "latest_checkpointed_iteration.json"
+    assert latest.is_file()
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 3
+    assert (ckpt_root / "global_step2" / "state").is_dir()
+    assert (ckpt_root / "global_step3" / "state").is_dir()
+    assert (ckpt_root / "global_step3" / "training_config.yml").is_file()
+    assert (ckpt_root / "global_step3" / "rng_state-0.json").is_file()
+
+    # resume for 2 more steps
+    MeshManager.destroy()
+    args2 = _training_args(tmp_path, num_steps=5, load_path=str(ckpt_root))
+    finetune.main(args=args2)
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 5
+
+    # unshard to HF layout
+    MeshManager.destroy()
+    unshard_args = UnshardingArgs(
+        load_args=dict(load_path=str(ckpt_root)),
+        unsharded_path=str(tmp_path / "unsharded"),
+    )
+    unshard.main(args=unshard_args)
+    assert (tmp_path / "unsharded" / "config.json").is_file()
+    assert any(
+        name.endswith(".safetensors") for name in os.listdir(tmp_path / "unsharded")
+    )
+
+    # restored params load back through the HF-interop reader
+    from dolomite_engine_tpu.utils.safetensors import SafeTensorsWeightsManager
+
+    manager = SafeTensorsWeightsManager(str(tmp_path / "unsharded"))
+    assert manager.has_tensor("transformer.wte.weight")
