@@ -38,6 +38,16 @@ def build_mesh_from_args(args) -> Mesh:
     return MeshManager.get_mesh()
 
 
+def get_data_parallel_world_size(args) -> int:
+    """Devices on the data-parallel axes (dp x fsdp) = devices not used by model parallelism.
+    Single source of truth for consumed-samples accounting and loader sharding."""
+    dist = args.distributed_args
+    model_parallel = max(
+        dist.tensor_parallel_size * dist.context_parallel_size * dist.expert_parallel_size, 1
+    )
+    return max(jax.device_count() // model_parallel, 1)
+
+
 def get_state_shardings(
     model: ModelWrapper,
     optimizer: optax.GradientTransformation,

@@ -172,7 +172,9 @@ def train(
                 jax_rng=jax_rng,
             )
 
-    if eval_during_training:
+    # final eval only when the loop didn't just run one at this step (reference finetune.py
+    # evaluates only in-loop)
+    if eval_during_training and (not eval_interval or global_step % eval_interval != 0):
         evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
 
 

@@ -1,0 +1,326 @@
+"""Pretraining trainer entry point: `python -m dolomite_engine_tpu.pretrain --config cfg.yml`.
+
+Parity: reference `dolomite_engine/pretrain.py` (375 LoC): `main` (283-371) wires args ->
+distributed -> model -> megatron dataloaders -> train; `train` (60-219) is a step-driven loop
+with consumed-samples accounting, FLOPs + billion-tokens/day throughput reporting, profiler
+hook, periodic eval (222-280) and checkpointing with consumed-samples metadata (195-210).
+
+TPU deltas: the global step is ONE jitted function (grad accumulation via `lax.scan`); the val
+"is loader None" TP broadcast (pretrain.py:245-258) is unnecessary — every host builds its own
+loader shard deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .arguments import TrainingArgs, get_args
+from .checkpointing import (
+    get_experiments_tracker_checkpoint_metadata,
+    load_checkpoint_for_training,
+    save_checkpoint,
+)
+from .data.megatron import get_megatron_gpt_dataloaders
+from .distributed import (
+    build_mesh_from_args,
+    create_sharded_train_state,
+    get_data_parallel_world_size,
+)
+from .enums import Mode, TuningMethod
+from .finetune import build_optimizer_from_args
+from .model_wrapper import get_model, log_model
+from .train_utils import (
+    get_model_tflops,
+    get_profiler_context,
+    make_eval_step,
+    make_train_step,
+    track_train_metrics,
+)
+from .utils import (
+    ExperimentsTracker,
+    ProgressBar,
+    init_distributed,
+    log_rank_0,
+    setup_tf32,
+)
+
+
+def track_val_metrics(
+    global_step: int,
+    val_loss: float,
+    experiments_tracker: ExperimentsTracker | None,
+    group_name: str | None = None,
+) -> None:
+    """Reference `pretrain.py:38-57`."""
+    message = f"step = {global_step}, val_loss = {val_loss:.4f}"
+    if group_name is not None:
+        message += f", group_name = {group_name}"
+    log_rank_0(logging.INFO, message)
+
+    if experiments_tracker is not None:
+        key = "loss" if group_name is None else f"loss-{group_name}"
+        experiments_tracker.track({key: val_loss}, step=global_step, context="val")
+
+
+def evaluate(
+    val_dataloaders: list,
+    model,
+    state,
+    global_step: int,
+    experiments_tracker: ExperimentsTracker | None,
+    eval_steps: int,
+    eval_step_fn,
+) -> float | None:
+    """eval_steps batches from each val group (reference `pretrain.py:222-280`)."""
+    if not val_dataloaders or all(dl is None for dl in val_dataloaders):
+        return None
+
+    group_loss = None
+    for group_index, loader in enumerate(val_dataloaders):
+        if loader is None:
+            continue
+        loss_sum, count = 0.0, 0
+        for _ in range(eval_steps):
+            try:
+                batch = next(loader)
+            except StopIteration:
+                break
+            loss_sum += float(eval_step_fn(state.params, batch["text"]))
+            count += 1
+        if count == 0:
+            continue
+        group_loss = loss_sum / count
+        track_val_metrics(
+            global_step,
+            group_loss,
+            experiments_tracker,
+            group_name=str(group_index) if len(val_dataloaders) > 1 else None,
+        )
+    return group_loss
+
+
+def train(
+    args: TrainingArgs,
+    model,
+    state,
+    optimizer,
+    lr_schedule,
+    train_dataloader,
+    val_dataloaders: list,
+    test_dataloaders: list,
+    experiments_tracker: ExperimentsTracker | None,
+    starting_iteration: int = 0,
+    consumed_samples: int = 0,
+    jax_rng: jax.Array | None = None,
+) -> None:
+    """Main pretraining loop (reference `pretrain.py:60-219`)."""
+    num_training_steps = args.training_parameters.num_training_steps
+    gradient_accumulation_steps = args.training_parameters.gradient_accumulation_steps
+    micro_batch_size = args.training_parameters.micro_batch_size
+    sequence_length = args.datasets[0].class_args.get("sequence_length")
+    eval_during_training = args.training_parameters.eval_during_training
+    eval_interval = args.training_parameters.eval_interval
+    eval_steps = args.datasets[0].class_args.get("eval_steps", 0) or 0
+    save_interval = args.save_args.save_interval
+    log_interval = args.logging_args.log_interval
+
+    dp_world_size = get_data_parallel_world_size(args)
+    samples_per_step = micro_batch_size * gradient_accumulation_steps * dp_world_size
+    tokens_per_step = samples_per_step * sequence_length
+
+    # analytic TFLOPs for the whole global batch, reported per model-parallel device group
+    # (reference get_model_tflops is per GPU; under SPMD we divide by dp_world)
+    step_tflops = get_model_tflops(
+        model.config,
+        batch_size=micro_batch_size * gradient_accumulation_steps,
+        sequence_length=sequence_length,
+        gradient_checkpointing_method=args.distributed_args.gradient_checkpointing_method,
+        gradient_checkpointing_args=args.distributed_args.gradient_checkpointing_args,
+    )
+
+    def loss_fn(params, text, rng):
+        rngs = None if rng is None else {"dropout": rng}
+        return model.loss(params, text, rngs=rngs, train=True)
+
+    train_step = jax.jit(
+        make_train_step(
+            lambda params, micro, rng: loss_fn(params, micro["text"], rng),
+            optimizer,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+            gradient_clipping=args.training_parameters.gradient_clipping,
+        ),
+        donate_argnums=(0,),
+    )
+    eval_step_fn = jax.jit(
+        make_eval_step(lambda params, text, rng: model.loss(params, text, rngs=None, train=False))
+    )
+
+    if jax_rng is None:
+        jax_rng = jax.random.PRNGKey(args.random_args.seed)
+
+    if eval_during_training and starting_iteration == 0 and eval_steps:
+        evaluate(
+            val_dataloaders, model, state, 0, experiments_tracker, eval_steps, eval_step_fn
+        )
+
+    loss_running_sum, loss_running_count = 0.0, 0
+    progress = ProgressBar(starting_iteration, num_training_steps)
+
+    global_step = starting_iteration
+    while global_step < num_training_steps:
+        global_step += 1
+        step_start = time.perf_counter()
+
+        micros = [next(train_dataloader) for _ in range(gradient_accumulation_steps)]
+        batch = {"text": jnp.stack([m["text"] for m in micros])}
+
+        jax_rng, step_rng = jax.random.split(jax_rng)
+        with get_profiler_context(
+            args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
+        ):
+            state, metrics = train_step(state, batch, step_rng)
+
+        consumed_samples += samples_per_step
+
+        if global_step % log_interval == 0:
+            loss = float(metrics["loss"])
+            step_time = time.perf_counter() - step_start
+            loss_running_sum += loss
+            loss_running_count += 1
+            track_train_metrics(
+                global_step=global_step,
+                train_loss_step=loss,
+                grad_norm=float(metrics["grad_norm"]),
+                current_lr=float(lr_schedule(global_step)),
+                experiments_tracker=experiments_tracker,
+                loss_running_mean=loss_running_sum / max(loss_running_count, 1),
+                flops=step_tflops / step_time,
+                billion_tokens_per_day=tokens_per_step * 86400 / step_time / 1e9,
+                step_time=step_time,
+            )
+
+        progress.track(global_step)
+
+        if (
+            eval_during_training
+            and eval_interval
+            and eval_steps
+            and global_step % eval_interval == 0
+        ):
+            evaluate(
+                val_dataloaders,
+                model,
+                state,
+                global_step,
+                experiments_tracker,
+                eval_steps,
+                eval_step_fn,
+            )
+
+        if global_step % save_interval == 0 or global_step == num_training_steps:
+            save_checkpoint(
+                args,
+                model,
+                state,
+                None,  # megatron loaders resume via consumed_samples metadata
+                experiments_tracker,
+                global_step,
+                jax_rng=jax_rng,
+                metadata={"consumed_samples": consumed_samples},
+            )
+
+    # final test-set evaluation (reference `pretrain.py:216` evaluates test loaders after
+    # training; val was already evaluated in-loop at this step when the interval divides)
+    if eval_during_training and eval_steps:
+        test_loss = evaluate(
+            test_dataloaders,
+            model,
+            state,
+            global_step,
+            None,
+            eval_steps,
+            eval_step_fn,
+        )
+        if test_loss is not None:
+            if experiments_tracker is not None:
+                experiments_tracker.track({"loss": test_loss}, step=global_step, context="test")
+            log_rank_0(logging.INFO, f"step = {global_step}, test_loss = {test_loss:.4f}")
+
+
+def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
+    """Reference `pretrain.py:283-371`."""
+    setup_tf32()
+
+    if args is None:
+        args = get_args(mode)
+
+    assert (
+        args.tuning_args.tuning_method == TuningMethod.pretraining
+    ), "pretraining requires tuning_method = pretraining"
+
+    init_distributed(timeout_minutes=args.distributed_args.timeout_minutes)
+
+    import transformers
+
+    transformers.set_seed(args.random_args.seed)
+    np.random.seed(args.random_args.seed)
+
+    model = get_model(args, mode)
+    log_model(model)
+
+    mesh = build_mesh_from_args(args)
+
+    optimizer, lr_schedule = build_optimizer_from_args(args, model)
+
+    rng = jax.random.PRNGKey(args.random_args.seed)
+    state, _ = create_sharded_train_state(model, optimizer, mesh, rng)
+
+    starting_iteration = 0
+    consumed_samples = 0
+    jax_rng = None
+    if args.load_args is not None:
+        state, starting_iteration, metadata, jax_rng = load_checkpoint_for_training(
+            args, state, None, experiments_tracker=None
+        )
+        if metadata is not None:
+            consumed_samples = metadata.get("consumed_samples", 0)
+
+    train_dataloader, val_dataloaders, test_dataloaders = get_megatron_gpt_dataloaders(
+        args, model.tokenizer, consumed_samples, mesh=mesh
+    )
+
+    experiments_tracker = ExperimentsTracker(
+        experiment_name="dolomite-tpu-pretrain",
+        tracker_name=args.logging_args.experiments_tracker_name,
+        aim_args=args.logging_args.aim_args,
+        wandb_args=args.logging_args.wandb_args,
+        checkpoint_metadata=get_experiments_tracker_checkpoint_metadata(args),
+    )
+    experiments_tracker.log_args(args)
+
+    with mesh:
+        train(
+            args,
+            model,
+            state,
+            optimizer,
+            lr_schedule,
+            train_dataloader,
+            val_dataloaders,
+            test_dataloaders,
+            experiments_tracker,
+            starting_iteration=starting_iteration,
+            consumed_samples=consumed_samples,
+            jax_rng=jax_rng,
+        )
+
+    experiments_tracker.finish()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""End-to-end pretrain over a megatron mmap corpus -> checkpoint -> resume, on the virtual
+CPU mesh. Covers the reference flagship path (`pretrain.py` + `data/megatron/`)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.arguments import TrainingArgs
+from dolomite_engine_tpu.data.megatron import MMapIndexedDatasetBuilder
+
+
+class _StubTokenizer:
+    eos_token_id = 1
+    pad_token_id = 2
+    vocab_size = 128
+
+    def __len__(self):
+        return self.vocab_size
+
+    def save_pretrained(self, path):
+        pass
+
+
+def _write_corpus(tmp_path, num_docs=200, vocab=128, seed=0) -> str:
+    rng = np.random.RandomState(seed)
+    prefix = str(tmp_path / "corpus")
+    builder = MMapIndexedDatasetBuilder(prefix + ".bin", dtype=np.uint16)
+    for _ in range(num_docs):
+        builder.add_item(rng.randint(0, vocab, size=rng.randint(10, 80)))
+        builder.end_document()
+    builder.finalize(prefix + ".idx")
+    return prefix
+
+
+def _training_args(tmp_path, prefix, num_steps=3, load_path=None) -> TrainingArgs:
+    cfg = dict(
+        model_args=dict(
+            model_class="AutoModelForCausalLM",
+            pretrained_config=dict(
+                model_type="gpt_dolomite",
+                vocab_size=128,
+                n_positions=64,
+                n_embd=32,
+                n_layer=2,
+                n_head=4,
+                attention_head_type="mha",
+                position_embedding_type="rope",
+                activation_function="swiglu",
+                normalization_function="rmsnorm",
+                add_bias=False,
+                resid_pdrop=0.0,
+                embd_pdrop=0.0,
+                attn_pdrop=0.0,
+                bos_token_id=0,
+                eos_token_id=1,
+                pad_token_id=2,
+            ),
+        ),
+        tuning_args=dict(tuning_method="pretraining"),
+        training_parameters=dict(
+            num_training_steps=num_steps,
+            micro_batch_size=2,
+            gradient_accumulation_steps=2,
+            eval_during_training=True,
+            eval_interval=2,
+        ),
+        datasets=[
+            dict(
+                class_name="MegatronDataset",
+                data_name="Megatron",
+                class_args=dict(
+                    eval_steps=1,
+                    data_cache_path=str(tmp_path / "cache"),
+                    data_path=[prefix],
+                    split="90,5,5",
+                    sequence_length=32,
+                ),
+            )
+        ],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=2),
+        logging_args=dict(log_interval=1),
+        random_args=dict(seed=7),
+    )
+    if load_path is not None:
+        cfg["load_args"] = dict(load_path=load_path)
+    return TrainingArgs(**cfg)
+
+
+@pytest.fixture()
+def stub_tokenizer(monkeypatch):
+    from dolomite_engine_tpu.model_wrapper import base as mw_base
+
+    def _setup(self, tokenizer_name, additional_special_tokens):
+        self.tokenizer = _StubTokenizer()
+
+    monkeypatch.setattr(mw_base.ModelWrapper, "_setup_tokenizer", _setup)
+
+
+def test_pretrain_save_resume(tmp_path, stub_tokenizer, eight_devices):
+    from dolomite_engine_tpu import pretrain
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    prefix = _write_corpus(tmp_path)
+
+    MeshManager.destroy()
+    args = _training_args(tmp_path, prefix, num_steps=3)
+    pretrain.main(args=args)
+
+    ckpt_root = tmp_path / "ckpt"
+    latest = ckpt_root / "latest_checkpointed_iteration.json"
+    assert latest.is_file()
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 3
+
+    # consumed-samples metadata: 3 steps * micro 2 * accum 2 * dp 8 = 96
+    with open(ckpt_root / "global_step3" / "metadata.json") as f:
+        assert json.load(f)["consumed_samples"] == 96
+
+    # resume for 2 more steps; megatron loaders restart from consumed_samples
+    MeshManager.destroy()
+    args2 = _training_args(tmp_path, prefix, num_steps=5, load_path=str(ckpt_root))
+    pretrain.main(args=args2)
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 5
+    with open(ckpt_root / "global_step5" / "metadata.json") as f:
+        assert json.load(f)["consumed_samples"] == 160
