@@ -15,6 +15,7 @@ from .config import (
     RNNDolomiteConfig,
 )
 from .gpt_dolomite import CausalLMOutput, GPTDolomiteForCausalLM, GPTDolomiteModel
+from .moe_dolomite import MoEDolomiteForCausalLM, MoEDolomiteModel
 
 _CONFIG_CLASSES: dict[str, type] = {
     "gpt_dolomite": CommonConfig,
@@ -26,6 +27,7 @@ _CONFIG_CLASSES: dict[str, type] = {
 
 _MODEL_CLASSES: dict[str, type] = {
     "gpt_dolomite": GPTDolomiteForCausalLM,
+    "moe_dolomite": MoEDolomiteForCausalLM,
 }
 
 

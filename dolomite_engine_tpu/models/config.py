@@ -135,7 +135,6 @@ class MoEConfig(CommonConfig):
     num_experts: int = 8
     num_experts_per_tok: int = 2
     router_aux_loss_coef: float = 0.01
-    shared_n_inner: int | None = None
 
 
 @dataclass

@@ -108,7 +108,7 @@ class GPTDolomiteModel(nn.Module):
         cache_index: jax.Array | None = None,
         deterministic: bool = True,
         inputs_embeds: jax.Array | None = None,
-    ) -> tuple[jax.Array, list[KVCache] | None]:
+    ) -> tuple[jax.Array, list[KVCache] | None, list]:
         config = self.config
         batch, seq = input_ids.shape
 
@@ -142,8 +142,9 @@ class GPTDolomiteModel(nn.Module):
         )
 
         new_caches = [] if kv_caches is not None else None
+        extras = []  # per-block extra outputs (MoE router logits etc.)
         for i, block in enumerate(self.h):
-            hidden_states, cache = block(
+            out = block(
                 hidden_states,
                 attention_mask,
                 segment_ids,
@@ -153,11 +154,14 @@ class GPTDolomiteModel(nn.Module):
                 cache_index,
                 deterministic,
             )
+            hidden_states, cache = out[0], out[1]
+            if len(out) > 2 and out[2] is not None:
+                extras.append(out[2])
             if new_caches is not None:
                 new_caches.append(cache)
 
         hidden_states = self.ln_f(hidden_states)
-        return hidden_states, new_caches
+        return hidden_states, new_caches, extras
 
 
 class GPTDolomiteForCausalLM(nn.Module):
@@ -167,13 +171,17 @@ class GPTDolomiteForCausalLM(nn.Module):
     checkpoint_every: int = 0
     base_model_cls: type = GPTDolomiteModel
 
-    def setup(self) -> None:
-        self.transformer = self.base_model_cls(
+    def _transformer_kwargs(self) -> dict:
+        """Hook for subclasses to pass extra kwargs to the base model (e.g. moe_implementation)."""
+        return dict(
             config=self.config,
             attention_implementation=self.attention_implementation,
             dtype=self.dtype,
             checkpoint_every=self.checkpoint_every,
         )
+
+    def setup(self) -> None:
+        self.transformer = self.base_model_cls(**self._transformer_kwargs())
         if not self.config.tie_word_embeddings:
             self.lm_head = ParameterizedLinear(
                 features=self.config.vocab_size,
@@ -196,7 +204,7 @@ class GPTDolomiteForCausalLM(nn.Module):
         compute_loss: bool = False,
         inputs_embeds: jax.Array | None = None,
     ) -> CausalLMOutput:
-        hidden_states, new_caches = self.transformer(
+        hidden_states, new_caches, extras = self.transformer(
             input_ids,
             position_ids=position_ids,
             attention_mask=attention_mask,
@@ -210,6 +218,7 @@ class GPTDolomiteForCausalLM(nn.Module):
         logits = self.compute_logits(hidden_states)
 
         loss = None
+        aux_loss = None
         if compute_loss or labels is not None:
             loss = causal_lm_loss(
                 logits,
@@ -219,8 +228,20 @@ class GPTDolomiteForCausalLM(nn.Module):
                 segment_ids=segment_ids,
                 labels=labels,
             )
+            aux_loss = self.compute_aux_loss(extras, attention_mask, segment_ids)
+            if aux_loss is not None:
+                loss = loss + aux_loss
 
-        return CausalLMOutput(logits=logits, loss=loss, kv_caches=new_caches)
+        return CausalLMOutput(logits=logits, loss=loss, kv_caches=new_caches, aux_loss=aux_loss)
+
+    def compute_aux_loss(
+        self,
+        extras: list,
+        attention_mask: jax.Array | None,
+        segment_ids: jax.Array | None,
+    ) -> jax.Array | None:
+        """Hook for MoE subclasses: auxiliary loss from per-block extras (router logits)."""
+        return None
 
     def compute_logits(self, hidden_states: jax.Array) -> jax.Array:
         if self.config.tie_word_embeddings:

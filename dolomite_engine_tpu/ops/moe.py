@@ -1,0 +1,135 @@
+"""MoE ops: routing, load-balancing loss, and grouped expert compute.
+
+Parity: reference `hf_models/models/moe_dolomite/moe/`:
+  - routing (`base.py:124-135`): gate linear -> top-k logits -> softmax over the SELECTED
+    logits in fp32 (not softmax-then-gather) -> cast back to input dtype.
+  - eager expert compute (`base.py:137-178`): sort tokens by expert, per-expert matmul.
+  - ScatterMoE (`moe/scatter.py:56-141`): external Triton `parallel_linear` kernels over
+    flatten_and_sort / padded_block_indices. The TPU-native equivalent here is
+    `jax.lax.ragged_dot` (grouped GEMM over contiguous expert groups) after a stable sort of
+    token-expert assignments — same dropless semantics, MXU-friendly, no capacity factor.
+  - load-balancing aux loss (`moe_dolomite/base.py:24-43`) delegates to HF mixtral
+    `load_balancing_loss_func`; the exact formula is reimplemented in
+    `load_balancing_loss` below (concat layers -> softmax -> top-k mask -> E * sum(frac * prob)).
+
+The "eager" path below intentionally runs every expert on every token (dense einsum over the
+expert axis). That costs num_experts/top_k extra FLOPs but is fully static, shards cleanly over
+the "ep" mesh axis (einsum contraction -> psum inserted by GSPMD), and is the numerical
+reference for the ragged path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def route(
+    router_logits: jax.Array, top_k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k routing (reference `moe/base.py:124-135, 176-183`).
+
+    Returns (router_weights [T, k] in input dtype, selected_experts [T, k] int32).
+    Softmax is computed over the selected top-k logits in fp32.
+    """
+    top_logits, selected = jax.lax.top_k(router_logits, top_k)
+    weights = jax.nn.softmax(top_logits.astype(jnp.float32), axis=-1)
+    return weights.astype(router_logits.dtype), selected.astype(jnp.int32)
+
+
+def load_balancing_loss(
+    router_logits: jax.Array, num_experts: int, top_k: int, token_mask: jax.Array | None = None
+) -> jax.Array:
+    """Switch/Mixtral auxiliary load-balancing loss.
+
+    `router_logits`: [num_layers * tokens, num_experts] (all layers concatenated, matching
+    HF `load_balancing_loss_func` as called at reference `moe_dolomite/base.py:39`).
+    `token_mask`: optional [num_layers * tokens] validity mask — the reference calls the HF
+    func WITHOUT a mask (pad tokens pollute router statistics); masking here matches what
+    HF's `attention_mask` argument does and is strictly more correct for padded batches.
+    """
+    routing_weights = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    _, selected_experts = jax.lax.top_k(routing_weights, top_k)
+    expert_mask = jax.nn.one_hot(selected_experts, num_experts, dtype=jnp.float32)  # [T, k, E]
+    if token_mask is None:
+        tokens_per_expert = jnp.mean(expert_mask, axis=0)  # [k, E]
+        router_prob_per_expert = jnp.mean(routing_weights, axis=0)  # [E]
+    else:
+        m = token_mask.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(m), 1.0)
+        tokens_per_expert = jnp.sum(expert_mask * m[:, None, None], axis=0) / denom
+        router_prob_per_expert = jnp.sum(routing_weights * m[:, None], axis=0) / denom
+    return jnp.sum(tokens_per_expert * router_prob_per_expert[None, :]) * num_experts
+
+
+def experts_eager(
+    x: jax.Array,
+    combine: jax.Array,
+    w_fc: jax.Array,
+    b_fc: jax.Array | None,
+    w_proj: jax.Array,
+    b_proj: jax.Array | None,
+    act: Callable,
+) -> jax.Array:
+    """Dense all-experts compute: every expert runs on every token, weighted by `combine`.
+
+    x: [T, d]; combine: [T, E] (zero for unselected experts); w_fc: [E, d, f];
+    w_proj: [E, f, d]. Shards over "ep" via the expert axis of the einsums.
+    """
+    h = jnp.einsum("td,edf->etf", x, w_fc)
+    if b_fc is not None:
+        h = h + b_fc[:, None, :]
+    h = act(h)
+    y = jnp.einsum("etf,efd->etd", h, w_proj)
+    if b_proj is not None:
+        y = y + b_proj[:, None, :]
+    return jnp.einsum("etd,te->td", y, combine.astype(y.dtype))
+
+
+def experts_ragged(
+    x: jax.Array,
+    router_weights: jax.Array,
+    selected_experts: jax.Array,
+    w_fc: jax.Array,
+    b_fc: jax.Array | None,
+    w_proj: jax.Array,
+    b_proj: jax.Array | None,
+    act: Callable,
+    num_experts: int,
+) -> jax.Array:
+    """Dropless grouped-GEMM expert compute (ScatterMoE equivalent, `moe/scatter.py:56-141`).
+
+    Stable-sort the (token, expert) assignments by expert id so each expert's tokens are
+    contiguous, then two `jax.lax.ragged_dot` grouped matmuls, then scatter-add back with the
+    routing gates (reference `_compute_experts` base.py:137-156).
+    """
+    tokens, hidden = x.shape
+    top_k = selected_experts.shape[-1]
+
+    flat_experts = selected_experts.reshape(-1)  # [T*k]
+    order = jnp.argsort(flat_experts, stable=True)  # [T*k]
+    token_index = order // top_k  # source token of each sorted slot
+    group_sizes = jnp.bincount(flat_experts, length=num_experts)
+
+    xs = jnp.take(x, token_index, axis=0)  # [T*k, d]
+    h = jax.lax.ragged_dot(xs, w_fc, group_sizes.astype(jnp.int32))
+    if b_fc is not None:
+        h = h + jnp.take(b_fc, jnp.take(flat_experts, order), axis=0)
+    h = act(h)
+    y = jax.lax.ragged_dot(h, w_proj, group_sizes.astype(jnp.int32))
+    if b_proj is not None:
+        y = y + jnp.take(b_proj, jnp.take(flat_experts, order), axis=0)
+
+    gates = jnp.take(router_weights.reshape(-1), order).astype(y.dtype)  # [T*k]
+    out = jnp.zeros((tokens, hidden), dtype=y.dtype)
+    return out.at[token_index].add(y * gates[:, None])
+
+
+def combine_weights(
+    router_weights: jax.Array, selected_experts: jax.Array, num_experts: int
+) -> jax.Array:
+    """Dense [T, E] combine matrix from top-k (weights, indices) — feeds `experts_eager`."""
+    one_hot = jax.nn.one_hot(selected_experts, num_experts, dtype=router_weights.dtype)
+    return jnp.einsum("tk,tke->te", router_weights, one_hot)
