@@ -32,9 +32,13 @@ class DebugDataset(BaseDataset):
                 "input": [token_id] * self.max_input_tokens,
                 "output": [token_id] * (self.max_output_tokens + 1),
             }
-        return {"output": [token_id] * self.max_input_tokens}
+        # reference emits {"output": ...} here (debug.py:59) but its own collate_fn reads
+        # i["input"] (utils.py:26) — emit the key generation actually consumes
+        return {"input": [token_id] * self.max_input_tokens}
 
     def __getitem__(self, index: int) -> dict:
+        if not (-self._length <= index < self._length):
+            raise IndexError(index)  # keeps the sequence-iteration protocol terminating
         if self._static_examples:
             return self._example
         return self._get_example(index % 100)

@@ -1,0 +1,148 @@
+"""Generation entry point: batch decode over test split -> jsonl.
+
+Parity: reference `dolomite_engine/generate.py` (140 LoC): iterates test datasets in
+`generation_parameters.batch_size` chunks through `collate_fn`, calls `model.generate`,
+writes `{generated_text, num_generated_tokens}` jsonl per dataset (lines 14-67); model comes
+either from `model_args` directly or from a training checkpoint via
+`load_checkpoint_for_inference` (lines 70-135). Single-device generation, matching the
+reference's hardcoded single GPU (generate.py:78-79).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+
+from .arguments import InferenceArgs, get_args
+from .checkpointing import load_checkpoint_for_inference, save_args
+from .data import get_datasets_list
+from .data.utils import collate_fn
+from .enums import DatasetKeys, DatasetSplit, Mode
+from .model_wrapper import ModelWrapperForFinetuning
+from .parallel.mesh import MeshManager
+from .utils import ProgressBar, log_rank_0, set_logger
+
+
+def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode) -> None:
+    """Main generation loop (reference `generate.py:14-67`)."""
+    batch_size = args.generation_parameters.batch_size
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    save_args(args, args.output_dir, mode)
+
+    generate_kwargs = args.generation_parameters.to_dict()
+    generate_kwargs.pop("batch_size", None)
+
+    progress_bar = ProgressBar(0, sum(len(dataset) for dataset in datasets_list))
+    rng = jax.random.PRNGKey(args.random_args.seed or 0)
+
+    for dataset in datasets_list:
+        output_path = os.path.join(args.output_dir, f"output-{dataset.data_name}.jsonl")
+        with open(output_path, "w") as output_file:
+            batch = []
+            for index in range(len(dataset)):
+                batch.append(dataset[index])
+                if len(batch) == batch_size or index == len(dataset) - 1:
+                    collated = collate_fn(
+                        batch,
+                        mode=mode,
+                        loss_mask=None,
+                        eos_token_id=model.eos_token_id,
+                        is_encoder_decoder=False,
+                        use_padding_free_transformer=False,
+                    )
+                    # static shapes: pad prompt width to a bucket and the (possibly ragged
+                    # final) batch up to batch_size, so the jitted decode compiles once per
+                    # bucket instead of once per batch
+                    real_rows = len(batch)
+                    collated = _pad_to_static_shapes(
+                        collated, batch_size, model.eos_token_id, width_multiple=64
+                    )
+                    rng, step_rng = jax.random.split(rng)
+                    texts, counts = model.generate(params, collated, generate_kwargs, step_rng)
+                    for text, count in zip(texts[:real_rows], counts[:real_rows]):
+                        output_file.write(
+                            json.dumps(
+                                {
+                                    DatasetKeys.generated_text.value: text,
+                                    DatasetKeys.num_generated_tokens.value: count,
+                                }
+                            )
+                            + "\n"
+                        )
+                    progress_bar.update(len(batch))
+                    batch = []
+        log_rank_0(20, f"wrote {output_path}")
+
+
+def _pad_to_static_shapes(
+    collated: dict, batch_size: int, eos_token_id: int, width_multiple: int = 64
+) -> dict:
+    """Left-pad prompts to the next width bucket and repeat-pad ragged batches to batch_size."""
+    import numpy as np
+
+    input_ids = np.asarray(collated["input_ids"])
+    attention_mask = np.asarray(collated["attention_mask"])
+    rows, width = input_ids.shape
+
+    target_width = -(-width // width_multiple) * width_multiple
+    if target_width != width:
+        pad = target_width - width
+        input_ids = np.pad(input_ids, ((0, 0), (pad, 0)), constant_values=eos_token_id)
+        attention_mask = np.pad(attention_mask, ((0, 0), (pad, 0)), constant_values=0)
+    if rows < batch_size:
+        reps = batch_size - rows
+        input_ids = np.concatenate([input_ids, np.repeat(input_ids[-1:], reps, axis=0)])
+        attention_mask = np.concatenate(
+            [attention_mask, np.repeat(attention_mask[-1:], reps, axis=0)]
+        )
+    return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def main(args: InferenceArgs | None = None) -> None:
+    mode = Mode.inference
+    set_logger()
+    if args is None:
+        args = get_args(mode)
+
+    if not MeshManager.is_initialized():
+        MeshManager()
+
+    if args.load_args is None:
+        model = ModelWrapperForFinetuning(
+            mode=mode,
+            model_name=args.model_args.model_name,
+            pretrained_config=args.model_args.pretrained_config,
+            model_class=args.model_args.model_class,
+            dtype=args.mixed_precision_args.dtype,
+            attention_implementation=args.model_args.attention_implementation,
+            tokenizer_name=args.tokenizer_args.tokenizer_name,
+            additional_special_tokens=args.tokenizer_args.additional_special_tokens,
+            trust_remote_code=args.model_args.trust_remote_code,
+        )
+        if args.model_args.model_name is not None:
+            params = model.load_pretrained_params(
+                args.model_args.model_name, MeshManager.get_mesh()
+            )
+        else:
+            # config-only model: random init (debug path)
+            params = model.init_params(
+                jax.random.PRNGKey(args.random_args.seed or 0), MeshManager.get_mesh()
+            )
+    else:
+        model, params, _training_args = load_checkpoint_for_inference(args, mode)
+
+    datasets_list, _ = get_datasets_list(
+        dataset_args_list=args.datasets,
+        split=DatasetSplit.test,
+        mode=mode,
+        tokenizer=model.tokenizer,
+    )
+
+    generate(args, model, params, datasets_list, mode)
+
+
+if __name__ == "__main__":
+    main()

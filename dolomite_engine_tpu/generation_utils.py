@@ -1,0 +1,134 @@
+"""Jitted autoregressive decoding with a preallocated KV cache.
+
+Parity: the reference delegates generation to HF `model.generate`
+(`model_wrapper/base.py:110-136`) — eager python loop over DynamicCache. The TPU-native
+design is a single compiled program: one prefill over the (left-padded) prompt, then
+`lax.scan` over decode steps writing into a static-shape KV cache — no per-step dispatch,
+no dynamic shapes, MXU-friendly.
+
+EOS semantics match HF: a row stops growing once it emits `eos_token_id`; later slots are
+filled with `pad_token_id`; `num_generated` counts emitted tokens including the EOS.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .ops.sampling import sample_token
+
+
+def generate_tokens(
+    model: Any,
+    params: Any,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int,
+    do_sample: bool = False,
+    temperature: float | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_token_id: int | None = None,
+    pad_token_id: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Decode `max_new_tokens` from left-padded prompts.
+
+    Returns (generated [B, max_new_tokens] int32, num_generated [B] int32).
+    Traceable: jit via `make_generate_fn` (everything but params/ids/mask/rng is static).
+    """
+    batch, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+
+    # left-padded prompts: position ids count real tokens; pad positions clamp to 0
+    position_ids = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+    num_real = jnp.sum(attention_mask, axis=1)  # [B]
+
+    # key-side mask over the whole cache: prompt padding stays masked, generated slots visible
+    full_mask = jnp.concatenate(
+        [attention_mask, jnp.ones((batch, max_new_tokens), attention_mask.dtype)], axis=1
+    )
+
+    caches = model.init_kv_caches(batch, total)
+    prefill = model.apply(
+        {"params": params} if "params" not in params else params,
+        input_ids,
+        position_ids=position_ids,
+        attention_mask=full_mask,
+        kv_caches=caches,
+        cache_index=jnp.zeros((), jnp.int32),
+    )
+
+    rng, step_rng = jax.random.split(rng)
+    first_token = sample_token(
+        prefill.logits[:, -1],
+        step_rng,
+        do_sample=do_sample,
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+    )
+
+    finished0 = (
+        jnp.zeros((batch,), bool) if eos_token_id is None else first_token == eos_token_id
+    )
+
+    def step(carry, i):
+        # i = index of the input token among generated tokens: written at cache slot
+        # prompt_len + i with position num_real + i; its logits sample token i+1
+        caches, token, finished, rng = carry
+        out = model.apply(
+            {"params": params} if "params" not in params else params,
+            token[:, None],
+            position_ids=(num_real + i)[:, None],
+            attention_mask=full_mask,
+            kv_caches=caches,
+            cache_index=prompt_len + i,
+        )
+        rng, step_rng = jax.random.split(rng)
+        next_token = sample_token(
+            out.logits[:, -1],
+            step_rng,
+            do_sample=do_sample,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+        )
+        # rows already finished emit padding and stay finished
+        next_token = jnp.where(finished, pad_token_id, next_token)
+        next_finished = finished
+        if eos_token_id is not None:
+            next_finished = finished | (next_token == eos_token_id)
+        return (out.kv_caches, next_token, next_finished, rng), next_token
+
+    (_, _, _, _), rest = jax.lax.scan(
+        step,
+        (prefill.kv_caches, first_token, finished0, rng),
+        jnp.arange(max_new_tokens - 1),
+    )
+    generated = jnp.concatenate([first_token[:, None], rest.T], axis=1)  # [B, max_new]
+
+    if eos_token_id is None:
+        num_generated = jnp.full((batch,), max_new_tokens, jnp.int32)
+    else:
+        is_eos = generated == eos_token_id
+        any_eos = jnp.any(is_eos, axis=1)
+        first_eos = jnp.argmax(is_eos, axis=1)
+        num_generated = jnp.where(any_eos, first_eos + 1, max_new_tokens).astype(jnp.int32)
+        # blank everything after the first EOS
+        keep = jnp.arange(max_new_tokens)[None, :] < num_generated[:, None]
+        generated = jnp.where(keep, generated, pad_token_id)
+
+    return generated, num_generated
+
+
+def make_generate_fn(model: Any, **static_kwargs):
+    """Jitted decode closure over a fixed model + generation settings; cache one per
+    (settings, shape) combination — e.g. `ModelWrapper.generate` keeps a dict."""
+
+    def fn(params, input_ids, attention_mask, rng):
+        return generate_tokens(model, params, input_ids, attention_mask, rng, **static_kwargs)
+
+    return jax.jit(fn)

@@ -1,1 +1,2 @@
+from .conversion import export_to_huggingface, import_from_huggingface
 from .weights import interleave_qkv, params_to_state_dict, split_qkv, state_dict_to_params

@@ -1,0 +1,569 @@
+"""Bidirectional HF <-> dolomite checkpoint conversion.
+
+Parity: reference `hf_models/model_conversion/` (1117 LoC) — `import_from_huggingface` /
+`export_to_huggingface` dispatch tables (`__init__.py:10-45`) over five families:
+  - llama      (llama.py):   separate q/k/v -> interleaved fused c_attn; up/gate -> fused
+                             [up; gate] c_fc; rmsnorm; rope
+  - granite    (granite.py): llama mapping + µP multipliers (embedding_multiplier -> m_emb,
+                             residual_multiplier -> m_residual, logits_scaling -> m_width,
+                             attention_multiplier)
+  - granitemoe (granitemoe.py): granite + MoE: router.layer -> mlp.gate, fused
+                             input_linear [E, 2I, H] with [gate; up] halves swapped to
+                             dolomite's [up; gate] (`_split_and_reorder_for_glu` :265-268),
+                             output_linear -> mlp.c_proj
+  - mixtral    (mixtral.py): per-expert w1(gate)/w3(up)/w2(down) -> stacked expert banks
+  - gpt_bigcode (bigcode.py): weights are layout-identical (mqa fused c_attn, learned
+                             positions); only the config is rewritten
+All work on numpy safetensors state dicts (torch [out, in] layout); `weights.py` handles
+dolomite-sd <-> flax params. Configs are read/written as raw json dicts so conversion does not
+depend on the installed transformers version knowing the architecture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from ..models.config import CommonConfig, MoEConfig
+from ..models.enums import AttentionHeadType
+from ..utils.safetensors import SafeTensorsWeightsManager
+from .weights import interleave_qkv, split_qkv
+
+# ---------------------------------------------------------------------------- helpers
+
+
+def _read_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        return json.load(f)
+
+
+def _write_config(config: dict, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config, f, indent=2, sort_keys=True)
+
+
+def _copy_tokenizer_files(src: str, dst: str) -> None:
+    for name in (
+        "tokenizer.json",
+        "tokenizer_config.json",
+        "special_tokens_map.json",
+        "vocab.json",
+        "merges.txt",
+        "tokenizer.model",
+    ):
+        p = os.path.join(src, name)
+        if os.path.isfile(p):
+            shutil.copy(p, os.path.join(dst, name))
+
+
+def _head_type_from_counts(num_heads: int, num_kv_heads: int) -> str:
+    if num_heads == num_kv_heads:
+        return "mha"
+    if num_kv_heads == 1:
+        return "mqa"
+    return "gqa"
+
+
+def _swap_glu_halves(weight: np.ndarray) -> np.ndarray:
+    """[gate; up] <-> [up; gate] on dim 1 of [E, 2I, H] (reference granitemoe.py:265-268);
+    self-inverse."""
+    x, y = np.split(weight, 2, axis=1)
+    return np.concatenate([y, x], axis=1)
+
+
+def _none_if(value, default):
+    return None if value == default else value
+
+
+# ---------------------------------------------------------------------------- llama / granite
+
+
+def _llama_like_config_to_dolomite(hf: dict) -> dict:
+    assert hf.get("hidden_act", "silu") == "silu"
+    # dolomite has one add_bias knob (reference llama.py:49 asserts the same)
+    assert hf.get("mlp_bias", False) == hf.get("attention_bias", False), (
+        "dolomite config cannot represent mlp_bias != attention_bias"
+    )
+    num_heads = hf["num_attention_heads"]
+    num_kv = hf.get("num_key_value_heads", num_heads)
+    config = dict(
+        model_type="gpt_dolomite",
+        vocab_size=hf["vocab_size"],
+        n_positions=hf.get("max_position_embeddings", 2048),
+        n_embd=hf["hidden_size"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=num_heads,
+        num_key_value_heads=num_kv,
+        attention_head_type=_head_type_from_counts(num_heads, num_kv),
+        position_embedding_type="rope",
+        n_inner=hf["intermediate_size"],
+        activation_function="swiglu",
+        normalization_function="rmsnorm",
+        layer_norm_epsilon=hf.get("rms_norm_eps", 1e-6),
+        use_cache=hf.get("use_cache", True),
+        add_bias=hf.get("attention_bias", False),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        initializer_range=hf.get("initializer_range", 0.02),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rope_scaling=hf.get("rope_scaling"),
+        attn_pdrop=hf.get("attention_dropout", 0.0),
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        bos_token_id=hf.get("bos_token_id"),
+        eos_token_id=hf.get("eos_token_id"),
+        pad_token_id=hf.get("pad_token_id"),
+    )
+    return config
+
+
+def _dolomite_config_to_llama_like(config: CommonConfig) -> dict:
+    assert config.activation_function == "swiglu"
+    assert config.normalization_function == "rmsnorm"
+    assert config.position_embedding_type == "rope"
+    return dict(
+        model_type="llama",
+        architectures=["LlamaForCausalLM"],
+        vocab_size=config.vocab_size,
+        max_position_embeddings=config.n_positions,
+        hidden_size=config.n_embd,
+        num_hidden_layers=config.n_layer,
+        num_attention_heads=config.n_head,
+        num_key_value_heads=config.num_key_value_heads,
+        intermediate_size=config.n_inner,
+        hidden_act="silu",
+        rms_norm_eps=config.layer_norm_epsilon,
+        use_cache=config.use_cache,
+        attention_bias=config.add_bias,
+        mlp_bias=config.add_bias,
+        tie_word_embeddings=config.tie_word_embeddings,
+        initializer_range=config.initializer_range,
+        rope_theta=config.rope_theta,
+        rope_scaling=config.rope_scaling,
+        attention_dropout=config.attn_pdrop,
+        bos_token_id=config.bos_token_id,
+        eos_token_id=config.eos_token_id,
+        pad_token_id=config.pad_token_id,
+    )
+
+
+def _import_llama_like_attention(
+    sd: dict, manager: SafeTensorsWeightsManager, i: int, config: CommonConfig
+) -> None:
+    p = f"model.layers.{i}.self_attn."
+    q = manager.get_tensor(p + "q_proj.weight")
+    k = manager.get_tensor(p + "k_proj.weight")
+    v = manager.get_tensor(p + "v_proj.weight")
+    sd[f"transformer.h.{i}.attn.c_attn.weight"] = interleave_qkv(q, k, v, config)
+    if manager.has_tensor(p + "q_proj.bias"):
+        sd[f"transformer.h.{i}.attn.c_attn.bias"] = interleave_qkv(
+            manager.get_tensor(p + "q_proj.bias"),
+            manager.get_tensor(p + "k_proj.bias"),
+            manager.get_tensor(p + "v_proj.bias"),
+            config,
+        )
+    sd[f"transformer.h.{i}.attn.c_proj.weight"] = manager.get_tensor(p + "o_proj.weight")
+    if manager.has_tensor(p + "o_proj.bias"):
+        sd[f"transformer.h.{i}.attn.c_proj.bias"] = manager.get_tensor(p + "o_proj.bias")
+
+
+def _export_llama_like_attention(
+    sd: dict, manager: SafeTensorsWeightsManager, i: int, config: CommonConfig
+) -> None:
+    p = f"transformer.h.{i}.attn."
+    q, k, v = split_qkv(manager.get_tensor(p + "c_attn.weight"), config)
+    out = f"model.layers.{i}.self_attn."
+    sd[out + "q_proj.weight"] = q
+    sd[out + "k_proj.weight"] = k
+    sd[out + "v_proj.weight"] = v
+    if manager.has_tensor(p + "c_attn.bias"):
+        qb, kb, vb = split_qkv(manager.get_tensor(p + "c_attn.bias"), config)
+        sd[out + "q_proj.bias"] = qb
+        sd[out + "k_proj.bias"] = kb
+        sd[out + "v_proj.bias"] = vb
+    sd[out + "o_proj.weight"] = manager.get_tensor(p + "c_proj.weight")
+    if manager.has_tensor(p + "c_proj.bias"):
+        sd[out + "o_proj.bias"] = manager.get_tensor(p + "c_proj.bias")
+
+
+def _import_backbone(manager: SafeTensorsWeightsManager, config, mlp_import_fn) -> dict:
+    """Shared HF->dolomite scaffold (embeddings, final norm, lm_head, per-layer norms +
+    attention); `mlp_import_fn(sd, manager, i)` fills the per-layer MLP/MoE weights."""
+    sd = {
+        "transformer.wte.weight": manager.get_tensor("model.embed_tokens.weight"),
+        "transformer.ln_f.weight": manager.get_tensor("model.norm.weight"),
+    }
+    if manager.has_tensor("lm_head.weight"):
+        sd["lm_head.weight"] = manager.get_tensor("lm_head.weight")
+    for i in range(config.n_layer):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        sd[dp + "ln_1.weight"] = manager.get_tensor(hp + "input_layernorm.weight")
+        sd[dp + "ln_2.weight"] = manager.get_tensor(hp + "post_attention_layernorm.weight")
+        mlp_import_fn(sd, manager, i)
+        _import_llama_like_attention(sd, manager, i, config)
+    return sd
+
+
+def _export_backbone(manager: SafeTensorsWeightsManager, config, mlp_export_fn) -> dict:
+    """Inverse of `_import_backbone`."""
+    sd = {
+        "model.embed_tokens.weight": manager.get_tensor("transformer.wte.weight"),
+        "model.norm.weight": manager.get_tensor("transformer.ln_f.weight"),
+    }
+    if manager.has_tensor("lm_head.weight"):
+        sd["lm_head.weight"] = manager.get_tensor("lm_head.weight")
+    for i in range(config.n_layer):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        sd[hp + "input_layernorm.weight"] = manager.get_tensor(dp + "ln_1.weight")
+        sd[hp + "post_attention_layernorm.weight"] = manager.get_tensor(dp + "ln_2.weight")
+        mlp_export_fn(sd, manager, i)
+        _export_llama_like_attention(sd, manager, i, config)
+    return sd
+
+
+def _finish_conversion(sd: dict, config_dict: dict, src: str, dst: str) -> None:
+    SafeTensorsWeightsManager.save_state_dict(sd, dst)
+    _write_config(config_dict, dst)
+    _copy_tokenizer_files(src, dst)
+
+
+def import_from_huggingface_llama(path: str, save_path: str, hf_config: dict | None = None) -> None:
+    """Reference `model_conversion/llama.py:13-34` (config mapping :37-75, weights :78-149)."""
+    hf = hf_config or _read_config(path)
+    config_dict = _llama_like_config_to_dolomite(hf)
+    config = CommonConfig.from_dict(config_dict)
+
+    manager = SafeTensorsWeightsManager(path)
+
+    def mlp(sd, manager, i):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        # fused GLU [up; gate] (reference mlp.py:53-58)
+        sd[dp + "mlp.c_fc.weight"] = np.concatenate(
+            [manager.get_tensor(hp + "mlp.up_proj.weight"), manager.get_tensor(hp + "mlp.gate_proj.weight")]
+        )
+        sd[dp + "mlp.c_proj.weight"] = manager.get_tensor(hp + "mlp.down_proj.weight")
+        if manager.has_tensor(hp + "mlp.up_proj.bias"):
+            sd[dp + "mlp.c_fc.bias"] = np.concatenate(
+                [manager.get_tensor(hp + "mlp.up_proj.bias"), manager.get_tensor(hp + "mlp.gate_proj.bias")]
+            )
+            sd[dp + "mlp.c_proj.bias"] = manager.get_tensor(hp + "mlp.down_proj.bias")
+
+    sd = _import_backbone(manager, config, mlp)
+    _finish_conversion(sd, config_dict, path, save_path)
+
+
+def export_to_huggingface_llama(path: str, save_path: str) -> None:
+    """Reference `model_conversion/llama.py:152-180` + state dict export :215-282."""
+    config = CommonConfig.from_pretrained(path)
+    hf_config = _dolomite_config_to_llama_like(config)
+
+    manager = SafeTensorsWeightsManager(path)
+
+    def mlp(sd, manager, i):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        up, gate = np.split(manager.get_tensor(dp + "mlp.c_fc.weight"), 2)
+        sd[hp + "mlp.up_proj.weight"] = up
+        sd[hp + "mlp.gate_proj.weight"] = gate
+        sd[hp + "mlp.down_proj.weight"] = manager.get_tensor(dp + "mlp.c_proj.weight")
+        if manager.has_tensor(dp + "mlp.c_fc.bias"):
+            upb, gateb = np.split(manager.get_tensor(dp + "mlp.c_fc.bias"), 2)
+            sd[hp + "mlp.up_proj.bias"] = upb
+            sd[hp + "mlp.gate_proj.bias"] = gateb
+            sd[hp + "mlp.down_proj.bias"] = manager.get_tensor(dp + "mlp.c_proj.bias")
+
+    sd = _export_backbone(manager, config, mlp)
+    _finish_conversion(sd, hf_config, path, save_path)
+
+
+def import_from_huggingface_granite(path: str, save_path: str) -> None:
+    """Reference `model_conversion/granite.py`: llama weights + µP multiplier knobs."""
+    hf = _read_config(path)
+    import_from_huggingface_llama(path, save_path, hf_config=hf)
+    config_dict = _read_config(save_path)
+    config_dict.update(
+        m_emb=_none_if(hf.get("embedding_multiplier", 1), 1),
+        m_residual=_none_if(hf.get("residual_multiplier", 1), 1),
+        m_width=_none_if(hf.get("logits_scaling", 1), 1),
+        attention_multiplier=hf.get("attention_multiplier"),
+    )
+    _write_config(config_dict, save_path)
+
+
+def export_to_huggingface_granite(path: str, save_path: str) -> None:
+    config = CommonConfig.from_pretrained(path)
+    export_to_huggingface_llama(path, save_path)
+    hf = _read_config(save_path)
+    hf.update(
+        model_type="granite",
+        architectures=["GraniteForCausalLM"],
+        embedding_multiplier=config.m_emb if config.m_emb is not None else 1,
+        residual_multiplier=config.m_residual if config.m_residual is not None else 1,
+        logits_scaling=config.m_width if config.m_width is not None else 1,
+        attention_multiplier=(
+            config.attention_multiplier
+            if config.attention_multiplier is not None
+            else (config.n_embd // config.n_head) ** -0.5
+        ),
+    )
+    _write_config(hf, save_path)
+
+
+# ---------------------------------------------------------------------------- MoE families
+
+
+def import_from_huggingface_mixtral(path: str, save_path: str) -> None:
+    """Reference `model_conversion/mixtral.py`: per-expert w1(gate)/w3(up)/w2(down) ->
+    stacked [E, *, *] expert banks; gate -> mlp.gate."""
+    hf = _read_config(path)
+    config_dict = _llama_like_config_to_dolomite(hf)
+    config_dict.update(
+        model_type="moe_dolomite",
+        num_experts=hf["num_local_experts"],
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.001),
+    )
+    config = MoEConfig.from_dict(config_dict)
+
+    manager = SafeTensorsWeightsManager(path)
+
+    def mlp(sd, manager, i):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        sd[dp + "mlp.gate.weight"] = manager.get_tensor(hp + "block_sparse_moe.gate.weight")
+        sd[dp + "mlp.c_fc.weight"] = np.stack(
+            [
+                np.concatenate(
+                    [
+                        manager.get_tensor(hp + f"block_sparse_moe.experts.{e}.w3.weight"),
+                        manager.get_tensor(hp + f"block_sparse_moe.experts.{e}.w1.weight"),
+                    ]
+                )
+                for e in range(config.num_experts)
+            ]
+        )
+        sd[dp + "mlp.c_proj.weight"] = np.stack(
+            [
+                manager.get_tensor(hp + f"block_sparse_moe.experts.{e}.w2.weight")
+                for e in range(config.num_experts)
+            ]
+        )
+
+    sd = _import_backbone(manager, config, mlp)
+    _finish_conversion(sd, config_dict, path, save_path)
+
+
+def export_to_huggingface_mixtral(path: str, save_path: str) -> None:
+    config = MoEConfig.from_pretrained(path)
+    hf_config = _dolomite_config_to_llama_like(config)
+    hf_config.update(
+        model_type="mixtral",
+        architectures=["MixtralForCausalLM"],
+        num_local_experts=config.num_experts,
+        num_experts_per_tok=config.num_experts_per_tok,
+        router_aux_loss_coef=config.router_aux_loss_coef,
+    )
+
+    manager = SafeTensorsWeightsManager(path)
+
+    def mlp(sd, manager, i):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        sd[hp + "block_sparse_moe.gate.weight"] = manager.get_tensor(dp + "mlp.gate.weight")
+        c_fc = manager.get_tensor(dp + "mlp.c_fc.weight")
+        c_proj = manager.get_tensor(dp + "mlp.c_proj.weight")
+        for e in range(config.num_experts):
+            up, gate = np.split(c_fc[e], 2)
+            sd[hp + f"block_sparse_moe.experts.{e}.w3.weight"] = up
+            sd[hp + f"block_sparse_moe.experts.{e}.w1.weight"] = gate
+            sd[hp + f"block_sparse_moe.experts.{e}.w2.weight"] = c_proj[e]
+
+    sd = _export_backbone(manager, config, mlp)
+    _finish_conversion(sd, hf_config, path, save_path)
+
+
+def import_from_huggingface_granitemoe(path: str, save_path: str) -> None:
+    """Reference `model_conversion/granitemoe.py`: router.layer -> mlp.gate; fused
+    input_linear [E, [gate; up], H] halves swapped to [up; gate]; + granite µP knobs."""
+    hf = _read_config(path)
+    config_dict = _llama_like_config_to_dolomite(hf)
+    config_dict.update(
+        model_type="moe_dolomite",
+        num_experts=hf["num_local_experts"],
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.001),
+        m_emb=_none_if(hf.get("embedding_multiplier", 1), 1),
+        m_residual=_none_if(hf.get("residual_multiplier", 1), 1),
+        m_width=_none_if(hf.get("logits_scaling", 1), 1),
+        attention_multiplier=hf.get("attention_multiplier"),
+    )
+    config = MoEConfig.from_dict(config_dict)
+
+    manager = SafeTensorsWeightsManager(path)
+
+    def mlp(sd, manager, i):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        sd[dp + "mlp.gate.weight"] = manager.get_tensor(hp + "block_sparse_moe.router.layer.weight")
+        sd[dp + "mlp.c_fc.weight"] = _swap_glu_halves(
+            manager.get_tensor(hp + "block_sparse_moe.input_linear.weight")
+        )
+        sd[dp + "mlp.c_proj.weight"] = manager.get_tensor(hp + "block_sparse_moe.output_linear.weight")
+
+    sd = _import_backbone(manager, config, mlp)
+    _finish_conversion(sd, config_dict, path, save_path)
+
+
+def export_to_huggingface_granitemoe(path: str, save_path: str) -> None:
+    config = MoEConfig.from_pretrained(path)
+    hf_config = _dolomite_config_to_llama_like(config)
+    hf_config.update(
+        model_type="granitemoe",
+        architectures=["GraniteMoeForCausalLM"],
+        num_local_experts=config.num_experts,
+        num_experts_per_tok=config.num_experts_per_tok,
+        router_aux_loss_coef=config.router_aux_loss_coef,
+        embedding_multiplier=config.m_emb if config.m_emb is not None else 1,
+        residual_multiplier=config.m_residual if config.m_residual is not None else 1,
+        logits_scaling=config.m_width if config.m_width is not None else 1,
+        attention_multiplier=(
+            config.attention_multiplier
+            if config.attention_multiplier is not None
+            else (config.n_embd // config.n_head) ** -0.5
+        ),
+    )
+
+    manager = SafeTensorsWeightsManager(path)
+
+    def mlp(sd, manager, i):
+        hp = f"model.layers.{i}."
+        dp = f"transformer.h.{i}."
+        sd[hp + "block_sparse_moe.router.layer.weight"] = manager.get_tensor(dp + "mlp.gate.weight")
+        sd[hp + "block_sparse_moe.input_linear.weight"] = _swap_glu_halves(
+            manager.get_tensor(dp + "mlp.c_fc.weight")
+        )
+        sd[hp + "block_sparse_moe.output_linear.weight"] = manager.get_tensor(dp + "mlp.c_proj.weight")
+
+    sd = _export_backbone(manager, config, mlp)
+    _finish_conversion(sd, hf_config, path, save_path)
+
+
+# ---------------------------------------------------------------------------- bigcode
+
+
+def import_from_huggingface_bigcode(path: str, save_path: str) -> None:
+    """Reference `model_conversion/bigcode.py`: weights are layout-identical (fused mqa/mha
+    c_attn, learned positions); only the config is rewritten."""
+    hf = _read_config(path)
+    assert hf.get("activation_function", "gelu_pytorch_tanh") in ("gelu_pytorch_tanh", "gelu")
+    config_dict = dict(
+        model_type="gpt_dolomite",
+        vocab_size=hf["vocab_size"],
+        n_positions=hf["n_positions"],
+        n_embd=hf["n_embd"],
+        n_layer=hf["n_layer"],
+        n_head=hf["n_head"],
+        attention_head_type="mqa" if hf.get("multi_query", True) else "mha",
+        position_embedding_type="learned_absolute",
+        n_inner=hf.get("n_inner"),
+        activation_function=hf.get("activation_function", "gelu_pytorch_tanh"),
+        normalization_function="layernorm",
+        layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        use_cache=hf.get("use_cache", True),
+        add_bias=True,
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        initializer_range=hf.get("initializer_range", 0.02),
+        attn_pdrop=hf.get("attn_pdrop", 0.1),
+        resid_pdrop=hf.get("resid_pdrop", 0.1),
+        embd_pdrop=hf.get("embd_pdrop", 0.1),
+        bos_token_id=hf.get("bos_token_id"),
+        eos_token_id=hf.get("eos_token_id"),
+        pad_token_id=hf.get("pad_token_id"),
+    )
+    CommonConfig.from_dict(config_dict)  # validate
+
+    os.makedirs(save_path, exist_ok=True)
+    manager = SafeTensorsWeightsManager(path)
+    SafeTensorsWeightsManager.save_state_dict(manager.state_dict(), save_path)
+    _write_config(config_dict, save_path)
+    _copy_tokenizer_files(path, save_path)
+
+
+def export_to_huggingface_bigcode(path: str, save_path: str) -> None:
+    config = CommonConfig.from_pretrained(path)
+    assert config.activation_function in ("gelu_pytorch_tanh", "gelu")
+    assert config.normalization_function == "layernorm"
+    assert config.position_embedding_type == "learned_absolute"
+    assert AttentionHeadType(config.attention_head_type) in (
+        AttentionHeadType.mqa,
+        AttentionHeadType.mha,
+    )
+    hf_config = dict(
+        model_type="gpt_bigcode",
+        architectures=["GPTBigCodeForCausalLM"],
+        vocab_size=config.vocab_size,
+        n_positions=config.n_positions,
+        n_embd=config.n_embd,
+        n_layer=config.n_layer,
+        n_head=config.n_head,
+        multi_query=config.attention_head_type == "mqa",
+        n_inner=config.n_inner,
+        activation_function=config.activation_function,
+        layer_norm_epsilon=config.layer_norm_epsilon,
+        use_cache=config.use_cache,
+        tie_word_embeddings=config.tie_word_embeddings,
+        initializer_range=config.initializer_range,
+        attn_pdrop=config.attn_pdrop,
+        resid_pdrop=config.resid_pdrop,
+        embd_pdrop=config.embd_pdrop,
+        bos_token_id=config.bos_token_id,
+        eos_token_id=config.eos_token_id,
+        pad_token_id=config.pad_token_id,
+    )
+
+    os.makedirs(save_path, exist_ok=True)
+    manager = SafeTensorsWeightsManager(path)
+    SafeTensorsWeightsManager.save_state_dict(manager.state_dict(), save_path)
+    _write_config(hf_config, save_path)
+    _copy_tokenizer_files(path, save_path)
+
+
+# ---------------------------------------------------------------------------- dispatch
+
+_MODEL_IMPORT_FUNCTIONS = {
+    "gpt_bigcode": import_from_huggingface_bigcode,
+    "granite": import_from_huggingface_granite,
+    "granitemoe": import_from_huggingface_granitemoe,
+    "llama": import_from_huggingface_llama,
+    "mixtral": import_from_huggingface_mixtral,
+}
+
+_MODEL_EXPORT_FUNCTIONS = {
+    "gpt_bigcode": export_to_huggingface_bigcode,
+    "granite": export_to_huggingface_granite,
+    "granitemoe": export_to_huggingface_granitemoe,
+    "llama": export_to_huggingface_llama,
+    "mixtral": export_to_huggingface_mixtral,
+}
+
+
+def import_from_huggingface(pretrained_model_name_or_path: str, save_path: str) -> None:
+    """Reference `model_conversion/__init__.py:19-27`. Local checkpoint dirs only (zero-egress
+    design: hub models must be downloaded out-of-band)."""
+    model_type = _read_config(pretrained_model_name_or_path)["model_type"]
+    if model_type not in _MODEL_IMPORT_FUNCTIONS:
+        raise NotImplementedError(f"the current model_type ({model_type}) is not yet supported")
+    _MODEL_IMPORT_FUNCTIONS[model_type](pretrained_model_name_or_path, save_path)
+
+
+def export_to_huggingface(pretrained_model_name_or_path: str, save_path: str, model_type: str) -> None:
+    """Reference `model_conversion/__init__.py:39-45`."""
+    if model_type not in _MODEL_EXPORT_FUNCTIONS:
+        raise NotImplementedError(f"the current model_type ({model_type}) is not yet supported")
+    _MODEL_EXPORT_FUNCTIONS[model_type](pretrained_model_name_or_path, save_path)

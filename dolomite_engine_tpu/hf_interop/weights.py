@@ -114,12 +114,29 @@ def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndar
         if "bias" in h["attn"]["c_proj"]:
             sd[p + "attn.c_proj.bias"] = h["attn"]["c_proj"]["bias"]
 
-        sd[p + "mlp.c_fc.weight"] = np.ascontiguousarray(h["mlp"]["c_fc"]["kernel"].T)
-        if "bias" in h["mlp"]["c_fc"]:
-            sd[p + "mlp.c_fc.bias"] = h["mlp"]["c_fc"]["bias"]
-        sd[p + "mlp.c_proj.weight"] = np.ascontiguousarray(h["mlp"]["c_proj"]["kernel"].T)
-        if "bias" in h["mlp"]["c_proj"]:
-            sd[p + "mlp.c_proj.bias"] = h["mlp"]["c_proj"]["bias"]
+        if config.model_type == "moe_dolomite":
+            # MoE block (reference sd names use "mlp."; moe_dolomite/moe/base.py): gate is a
+            # plain linear; expert banks are [E, out, in] torch vs [E, in, out] flax.
+            # detection keyed on model_type, matching state_dict_to_params below
+            moe = h["moe"]
+            sd[p + "mlp.gate.weight"] = np.ascontiguousarray(moe["gate"]["kernel"].T)
+            sd[p + "mlp.c_fc.weight"] = np.ascontiguousarray(
+                np.swapaxes(moe["c_fc"]["kernel"], 1, 2)
+            )
+            if "bias" in moe["c_fc"]:
+                sd[p + "mlp.c_fc.bias"] = moe["c_fc"]["bias"]
+            sd[p + "mlp.c_proj.weight"] = np.ascontiguousarray(
+                np.swapaxes(moe["c_proj"]["kernel"], 1, 2)
+            )
+            if "bias" in moe["c_proj"]:
+                sd[p + "mlp.c_proj.bias"] = moe["c_proj"]["bias"]
+        else:
+            sd[p + "mlp.c_fc.weight"] = np.ascontiguousarray(h["mlp"]["c_fc"]["kernel"].T)
+            if "bias" in h["mlp"]["c_fc"]:
+                sd[p + "mlp.c_fc.bias"] = h["mlp"]["c_fc"]["bias"]
+            sd[p + "mlp.c_proj.weight"] = np.ascontiguousarray(h["mlp"]["c_proj"]["kernel"].T)
+            if "bias" in h["mlp"]["c_proj"]:
+                sd[p + "mlp.c_proj.bias"] = h["mlp"]["c_proj"]["bias"]
 
     _norm_to_sd(sd, "transformer.ln_f.", t["ln_f"])
 
@@ -181,13 +198,31 @@ def state_dict_to_params(
         if config.add_bias:
             h["attn"]["c_proj"]["bias"] = get_tensor(p + "attn.c_proj.bias")
 
-        h["mlp"] = {
-            "c_fc": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_fc.weight").T)},
-            "c_proj": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_proj.weight").T)},
-        }
-        if config.add_bias:
-            h["mlp"]["c_fc"]["bias"] = get_tensor(p + "mlp.c_fc.bias")
-            h["mlp"]["c_proj"]["bias"] = get_tensor(p + "mlp.c_proj.bias")
+        if config.model_type == "moe_dolomite":
+            h["moe"] = {
+                "gate": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.gate.weight").T)},
+                "c_fc": {
+                    "kernel": np.ascontiguousarray(
+                        np.swapaxes(get_tensor(p + "mlp.c_fc.weight"), 1, 2)
+                    )
+                },
+                "c_proj": {
+                    "kernel": np.ascontiguousarray(
+                        np.swapaxes(get_tensor(p + "mlp.c_proj.weight"), 1, 2)
+                    )
+                },
+            }
+            if config.add_bias:
+                h["moe"]["c_fc"]["bias"] = get_tensor(p + "mlp.c_fc.bias")
+                h["moe"]["c_proj"]["bias"] = get_tensor(p + "mlp.c_proj.bias")
+        else:
+            h["mlp"] = {
+                "c_fc": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_fc.weight").T)},
+                "c_proj": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_proj.weight").T)},
+            }
+            if config.add_bias:
+                h["mlp"]["c_fc"]["bias"] = get_tensor(p + "mlp.c_fc.bias")
+                h["mlp"]["c_proj"]["bias"] = get_tensor(p + "mlp.c_proj.bias")
 
     t["ln_f"] = _norm_from_sd(get_tensor, "transformer.ln_f.", config)
 

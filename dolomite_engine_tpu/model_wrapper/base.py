@@ -195,6 +195,56 @@ class ModelWrapper:
             **batch,
         )
 
+    def generate(
+        self, params: Any, batch: dict, generate_kwargs: dict, rng: jax.Array | None = None
+    ) -> tuple[list[str], list[int]]:
+        """Batch generation (reference `model_wrapper/base.py:110-136` delegates to HF
+        `model.generate`; here a single jitted prefill+scan decode — `generation_utils.py`).
+
+        `batch` is the inference-mode `collate_fn` output: left-padded `input_ids` +
+        `attention_mask`. Returns (generated_text, num_generated_tokens) per row.
+        """
+        from ..generation_utils import make_generate_fn
+
+        assert self.tokenizer is not None, "generation requires a tokenizer"
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        input_ids = jnp.asarray(batch["input_ids"], jnp.int32)
+        attention_mask = jnp.asarray(batch["attention_mask"], jnp.int32)
+
+        top_p = generate_kwargs.get("top_p")
+        static = dict(
+            max_new_tokens=int(generate_kwargs["max_new_tokens"]),
+            do_sample=bool(generate_kwargs.get("do_sample") or False),
+            temperature=generate_kwargs.get("temperature"),
+            top_k=generate_kwargs.get("top_k"),
+            top_p=None if top_p is None else float(top_p),
+            eos_token_id=self.eos_token_id,
+            pad_token_id=self.tokenizer.pad_token_id or self.eos_token_id or 0,
+        )
+        cache_key = tuple(sorted(static.items()))
+        if not hasattr(self, "_generate_fns"):
+            self._generate_fns = {}
+        if cache_key not in self._generate_fns:
+            self._generate_fns[cache_key] = make_generate_fn(self.model, **static)
+        generated, num_generated = self._generate_fns[cache_key](
+            params, input_ids, attention_mask, rng
+        )
+
+        num_generated = [int(n) for n in num_generated]
+        texts = [
+            self.tokenizer.decode(row[:n], skip_special_tokens=True)
+            for row, n in zip(jax.device_get(generated), num_generated)
+        ]
+        return texts, num_generated
+
+    @property
+    def eos_token_id(self) -> int | None:
+        if self.tokenizer is not None and self.tokenizer.eos_token_id is not None:
+            return self.tokenizer.eos_token_id
+        return self.config.eos_token_id
+
     def num_parameters(self) -> int:
         return sum(
             int(jnp.prod(jnp.asarray(x.shape))) for x in jax.tree.leaves(self.abstract_params())

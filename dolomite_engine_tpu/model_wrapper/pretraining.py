@@ -87,10 +87,9 @@ class ModelWrapperForPretraining(ModelWrapper):
             rngs=rngs,
             **batch,
         )
-        loss = output.loss
-        if output.aux_loss is not None:
-            loss = loss + getattr(self.config, "router_aux_loss_coef", 0.0) * output.aux_loss
-        return loss
+        # output.loss already includes the scaled router aux loss (models/gpt_dolomite.py
+        # compute_aux_loss hook) — do not add it again
+        return output.loss
 
 
 class ModelWrapperForFinetuning(ModelWrapper):
@@ -119,10 +118,9 @@ class ModelWrapperForFinetuning(ModelWrapper):
             rngs=rngs,
             **inputs,
         )
-        loss = output.loss
-        if output.aux_loss is not None:
-            loss = loss + getattr(self.config, "router_aux_loss_coef", 0.0) * output.aux_loss
-        return loss
+        # output.loss already includes the scaled router aux loss (models/gpt_dolomite.py
+        # compute_aux_loss hook) — do not add it again
+        return output.loss
 
 
 def get_model(args, mode: Mode):

@@ -1,0 +1,60 @@
+"""Logits processors + token sampling for generation.
+
+Parity: reference generation goes through HF `model.generate(**generate_kwargs)`
+(`model_wrapper/base.py:110-136`) with `GenerationParameters` (arguments.py:450-466:
+do_sample / temperature / top_k / top_p / max_new_tokens). Here the processors are
+implemented directly (temperature scale -> top-k filter -> top-p nucleus filter ->
+categorical sample), all jit-compatible with static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def apply_temperature(logits: jax.Array, temperature: float) -> jax.Array:
+    return logits / jnp.maximum(temperature, 1e-6)
+
+
+def apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep the k highest logits per row, mask the rest (HF TopKLogitsWarper)."""
+    k = min(top_k, logits.shape[-1])
+    kth_best = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth_best, _NEG_INF, logits)
+
+
+def apply_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering (HF TopPLogitsWarper): keep the smallest set of tokens whose
+    cumulative probability exceeds top_p; the highest-probability token always survives."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    # keep tokens while the cumulative mass BEFORE them is < top_p
+    keep_sorted = (cumulative - jax.nn.softmax(sorted_logits, axis=-1)) < top_p
+    keep_sorted = keep_sorted.at[..., 0].set(True)
+    # threshold = smallest kept logit
+    threshold = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < threshold, _NEG_INF, logits)
+
+
+def sample_token(
+    logits: jax.Array,
+    rng: jax.Array,
+    do_sample: bool = False,
+    temperature: float | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Next-token choice from [B, V] logits -> [B] int32."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature is not None:
+        logits = apply_temperature(logits, temperature)
+    if top_k is not None and top_k > 0:
+        logits = apply_top_k(logits, top_k)
+    if top_p is not None and top_p < 1.0:
+        logits = apply_top_p(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
