@@ -15,7 +15,14 @@ from .config import (
     RNNDolomiteConfig,
 )
 from .gpt_dolomite import CausalLMOutput, GPTDolomiteForCausalLM, GPTDolomiteModel
+from .dense_moe import DenseMoEForCausalLM, DenseMoEModel
+from .gpt_crosslayer import (
+    GPTCrossLayerForCausalLM,
+    GPTCrossLayerModel,
+    convert_gpt_dolomite_to_gpt_crosslayer,
+)
 from .moe_dolomite import MoEDolomiteForCausalLM, MoEDolomiteModel
+from .rnn_dolomite import RNNDolomiteForCausalLM, RNNDolomiteModel
 
 _CONFIG_CLASSES: dict[str, type] = {
     "gpt_dolomite": CommonConfig,
@@ -28,6 +35,9 @@ _CONFIG_CLASSES: dict[str, type] = {
 _MODEL_CLASSES: dict[str, type] = {
     "gpt_dolomite": GPTDolomiteForCausalLM,
     "moe_dolomite": MoEDolomiteForCausalLM,
+    "gpt_crosslayer": GPTCrossLayerForCausalLM,
+    "dense_moe": DenseMoEForCausalLM,
+    "rnn_dolomite": RNNDolomiteForCausalLM,
 }
 
 

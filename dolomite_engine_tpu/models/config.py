@@ -140,32 +140,51 @@ class MoEConfig(CommonConfig):
 @dataclass
 class GPTCrossLayerConfig(CommonConfig):
     """Parity: reference `hf_models/models/gpt_crosslayer/config.py`: cross-layer KV sharing
-    pattern; `sharing_pattern[i]` = index of the layer whose KV cache layer i attends with."""
+    pattern; `sharing_pattern[i]` = index of the layer whose KV cache layer i attends with
+    (consecutive equal entries = one KV group). Attention head type is forced to gqa
+    (reference config.py:48). `joint_residual_stream` adds the group input to every
+    sub-layer residual."""
 
     model_type: str = "gpt_crosslayer"
     sharing_pattern: list[int] | None = None
+    joint_residual_stream: bool = False
 
     def __post_init__(self) -> None:
+        self.attention_head_type = "gqa"
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.n_head
         super().__post_init__()
         if self.sharing_pattern is None:
             self.sharing_pattern = list(range(self.n_layer))
-        assert all(
-            self.sharing_pattern[i] <= i for i in range(len(self.sharing_pattern))
-        ), "a layer can only share KV with an earlier (or its own) layer"
+        # reference validation (config.py:67-78): parents self-reference, non-decreasing,
+        # in range
         assert len(self.sharing_pattern) == self.n_layer
+        assert all(
+            self.sharing_pattern[i] == i for i in set(self.sharing_pattern)
+        ), "a filled sharing pattern doesn't have a parent layer"
+        assert all(
+            self.sharing_pattern[i] <= self.sharing_pattern[i + 1]
+            for i in range(len(self.sharing_pattern) - 1)
+        )
+        assert all(0 <= p < self.n_layer for p in self.sharing_pattern)
 
 
 @dataclass
 class DenseMoEConfig(CommonConfig):
     """Parity: reference `hf_models/models/dense_moe/config.py` ("Dense Training, Sparse
-    Inference"): wide MLP with per-expert soft routing; joint attention head gating."""
+    Inference"): wide MLP with per-expert soft routing; mixture-of-attention with one KV
+    head per expert (moa.py:25-27 sets num_key_value_heads = num_experts)."""
 
     model_type: str = "dense_moe"
-    num_experts: int = 32
+    num_experts: int = 8
 
     def __post_init__(self) -> None:
+        assert self.n_head % self.num_experts == 0, (
+            "number of attention heads must be divisible by the number of experts"
+        )
+        self.num_key_value_heads = self.num_experts
+        self.attention_head_type = "mha" if self.num_experts == self.n_head else "gqa"
         super().__post_init__()
-        assert self.n_head % self.num_experts == 0 or self.num_experts % self.n_head == 0 or True
 
 
 @dataclass

@@ -1,0 +1,324 @@
+"""DenseMoE: dense-training / sparse-inference MoE ("Dense Training, Sparse Inference:
+Rethinking Training of MoE Language Models").
+
+Parity: reference `hf_models/models/dense_moe/` (419 LoC) —
+  - `DenseMoE` MLP (moe.py:12-57): one wide MLP of width num_experts * n_inner with
+    per-expert soft routing weights repeat-interleaved across the activation;
+  - `DenseMoA_SDPA` (moa.py:14-): one KV head per expert; each expert's query-head group is
+    gated by its softmax routing weight after attention;
+  - `mask_probability` (inference.py:4-19): inference-time top-k / threshold masking of the
+    soft routing (no renormalization). The reference's top-k path scatters into
+    `torch.empty_like` (uninitialized memory — inference.py:15); here unselected entries are
+    zero, the evident intent.
+No load-balancing loss: training is dense, every expert sees every token.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..enums import AttentionImplementation
+from ..ops.activations import get_activation_function, is_glu
+from ..ops.attention import attention as attention_op
+from ..ops.rope import apply_rotary_pos_emb
+from .config import DenseMoEConfig
+from .enums import InitMethod
+from .gpt_dolomite import GPTDolomiteForCausalLM, GPTDolomiteModel
+from .modeling_utils import (
+    KVCache,
+    ParameterizedLinear,
+    get_norm,
+    get_softmax_scale,
+    update_kv_cache,
+)
+
+
+def mask_probability(p: jax.Array, inference_method: dict | None) -> jax.Array:
+    """Inference-time sparsification of soft routing weights (reference inference.py:4-19)."""
+    if inference_method is None:
+        return p
+    top_k = inference_method.get("top_k")
+    threshold = inference_method.get("threshold")
+    if threshold is not None:
+        return jnp.where(p < threshold, 0.0, p)
+    if top_k is not None:
+        kth_best = jax.lax.top_k(p, top_k)[0][..., -1:]
+        return jnp.where(p < kth_best, 0.0, p)
+    raise ValueError("unexpected inference_method")
+
+
+def _soft_routing(
+    gate: ParameterizedLinear, hidden_states: jax.Array, inference_method: dict | None
+) -> jax.Array:
+    logits = gate(hidden_states)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(logits.dtype)
+    return mask_probability(weights, inference_method)
+
+
+class DenseMoEMLP(nn.Module):
+    """Wide MLP with per-expert soft routing (reference moe.py:12-57): c_fc spans
+    num_experts * n_inner; routing weights repeat-interleave across the post-activation."""
+
+    config: DenseMoEConfig
+    dtype: Any = jnp.float32
+    inference_method: dict | None = None
+
+    @nn.compact
+    def __call__(self, hidden_states: jax.Array, deterministic: bool = True) -> jax.Array:
+        config = self.config
+        wide = config.num_experts * config.n_inner
+        glu = is_glu(config.activation_function)
+
+        init_method = InitMethod(config.init_method)
+        std = config.initializer_range
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_fc = ParameterizedLinear(
+            features=2 * wide if glu else wide,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "mlp"),
+            dtype=self.dtype,
+            name="c_fc",
+        )
+
+        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_proj = ParameterizedLinear(
+            features=config.n_embd,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("mlp", "embed"),
+            dtype=self.dtype,
+            name="c_proj",
+        )
+
+        gate = ParameterizedLinear(
+            features=config.num_experts,
+            use_bias=False,
+            std=config.initializer_range,
+            kernel_axes=(None, None),
+            dtype=self.dtype,
+            name="gate",
+        )
+
+        routing = _soft_routing(gate, hidden_states, self.inference_method)  # [B, S, E]
+        routing = jnp.repeat(routing, config.n_inner, axis=-1)  # [B, S, E*I]
+
+        act = get_activation_function(config.activation_function)
+        h = c_fc(hidden_states)
+        h = act(h)
+        h = h * routing.astype(h.dtype)
+        h = c_proj(h)
+        h = nn.Dropout(rate=config.resid_pdrop)(h, deterministic=deterministic)
+        return h
+
+
+class DenseMoA(nn.Module):
+    """Mixture-of-attention: one KV head per expert; each expert's query-head group gated by
+    its routing weight after attention (reference moa.py:14-127)."""
+
+    config: DenseMoEConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    inference_method: dict | None = None
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None = None,
+        alibi_bias: jax.Array | None = None,
+        kv_cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        config = self.config
+        num_heads = config.n_head
+        num_experts = config.num_experts
+        heads_per_expert = num_heads // num_experts
+        head_dim = config.head_dim
+
+        init_method = InitMethod(config.init_method)
+        std = config.initializer_range
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_attn = ParameterizedLinear(
+            features=(num_heads + 2 * num_experts) * head_dim,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "heads"),
+            dtype=self.dtype,
+            name="c_attn",
+        )
+
+        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_proj = ParameterizedLinear(
+            features=config.n_embd,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("heads", "embed"),
+            dtype=self.dtype,
+            name="c_proj",
+        )
+
+        gate = ParameterizedLinear(
+            features=num_experts,
+            use_bias=False,
+            std=config.initializer_range,
+            kernel_axes=(None, None),
+            dtype=self.dtype,
+            name="gate",
+        )
+
+        batch, seq = hidden_states.shape[:2]
+        qkv = c_attn(hidden_states)
+        query, key, value = jnp.split(
+            qkv, [num_heads * head_dim, (num_heads + num_experts) * head_dim], axis=-1
+        )
+        query = query.reshape(batch, seq, num_heads, head_dim)
+        key = key.reshape(batch, seq, num_experts, head_dim)
+        value = value.reshape(batch, seq, num_experts, head_dim)
+
+        if rope_cos_sin is not None:
+            cos, sin = rope_cos_sin
+            query = apply_rotary_pos_emb(query, cos, sin)
+            key = apply_rotary_pos_emb(key, cos, sin)
+
+        query_offset = 0
+        if kv_cache is not None:
+            assert cache_index is not None
+            key, value, kv_cache, attention_mask, query_offset = update_kv_cache(
+                key, value, kv_cache, cache_index, attention_mask
+            )
+
+        softmax_scale = get_softmax_scale(config, head_dim)
+
+        dropout_rng = None
+        attn_pdrop = 0.0 if deterministic else config.attn_pdrop
+        if attn_pdrop > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        out = attention_op(
+            query,
+            key,
+            value,
+            implementation=self.attention_implementation,
+            causal=True,
+            softmax_scale=softmax_scale,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            alibi_bias=alibi_bias,
+            softmax_in_fp32=config.attention_softmax_in_fp32,
+            dropout=attn_pdrop,
+            dropout_rng=dropout_rng,
+            query_offset=query_offset,
+        )  # [B, S, H, D]
+
+        routing = _soft_routing(gate, hidden_states, self.inference_method)  # [B, S, E]
+        out = out.reshape(batch, seq, num_experts, heads_per_expert, head_dim)
+        out = out * routing[..., :, None, None].astype(out.dtype)
+        out = out.reshape(batch, seq, num_heads * head_dim)
+
+        out = c_proj(out)
+        out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)
+        return out, kv_cache
+
+
+class DenseMoEBlock(nn.Module):
+    """Pre-norm block: DenseMoA attention + DenseMoE MLP (reference layer.py:10-38).
+    Signature matches `Block` for the shared model loop."""
+
+    config: DenseMoEConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    inference_method: dict | None = None
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None = None,
+        alibi_bias: jax.Array | None = None,
+        kv_cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        config = self.config
+        m_residual = config.m_residual
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_1")(hidden_states)
+        attn_out, kv_cache = DenseMoA(
+            config=config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            inference_method=self.inference_method,
+            name="attn",
+        )(
+            h,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            rope_cos_sin=rope_cos_sin,
+            alibi_bias=alibi_bias,
+            kv_cache=kv_cache,
+            cache_index=cache_index,
+            deterministic=deterministic,
+        )
+        if m_residual is not None:
+            attn_out = attn_out * m_residual
+        hidden_states = residual + attn_out
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_2")(hidden_states)
+        mlp_out = DenseMoEMLP(
+            config=config,
+            dtype=self.dtype,
+            inference_method=self.inference_method,
+            name="mlp",
+        )(h, deterministic=deterministic)
+        if m_residual is not None:
+            mlp_out = mlp_out * m_residual
+        hidden_states = residual + mlp_out
+
+        hidden_states = nn.with_logical_constraint(
+            hidden_states, ("act_batch", "act_seq", "act_embed")
+        )
+        return hidden_states, kv_cache
+
+
+class DenseMoEModel(GPTDolomiteModel):
+    """Decoder stack of DenseMoE blocks (reference `dense_moe/base.py`)."""
+
+    block_cls: type = DenseMoEBlock
+    inference_method: dict | None = None
+
+    def _make_block(self, cls: type, i: int) -> nn.Module:
+        return cls(
+            config=self.config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            inference_method=self.inference_method,
+        )
+
+
+class DenseMoEForCausalLM(GPTDolomiteForCausalLM):
+    """Causal LM over the DenseMoE stack (reference `dense_moe/main.py`)."""
+
+    base_model_cls: type = DenseMoEModel
+    inference_method: dict | None = None
+
+    def _transformer_kwargs(self) -> dict:
+        return dict(super()._transformer_kwargs(), inference_method=self.inference_method)

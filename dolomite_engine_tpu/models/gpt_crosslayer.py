@@ -1,0 +1,364 @@
+"""GPTCrossLayer: cross-layer KV-cache sharing (CLA — "Reducing Transformer Key-Value Cache
+Size with Cross-Layer Attention").
+
+Parity: reference `hf_models/models/gpt_crosslayer/` (962 LoC) — `GPTCrossLayerModel`
+(base.py:21), `GPTCrossLayerBlock`/`CrossLayer` (layer.py), `KeyValueProjection`
+(attention/base.py:129-162), dolomite converter (utils.py:11-141), config (config.py:
+`sharing_pattern[i]` = index of the layer whose KV layer i uses; consecutive equal entries
+form a KV group; `joint_residual_stream` adds the group input to every sub-layer residual).
+
+Structure: one `CrossLayerGroup` per distinct sharing target; the group computes K/V once
+(pre-norm + kv projection, rope applied) and every sub-layer runs query-only attention plus
+an MLP. KV cache holds one entry per GROUP — the memory saving that motivates CLA.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..enums import AttentionImplementation
+from ..ops.attention import attention as attention_op
+from ..ops.rope import apply_rotary_pos_emb
+from .config import GPTCrossLayerConfig
+from .enums import InitMethod
+from .gpt_dolomite import GPTDolomiteForCausalLM, GPTDolomiteModel
+from .modeling_utils import (
+    MLP,
+    KVCache,
+    ParameterizedLinear,
+    get_norm,
+    get_softmax_scale,
+    update_kv_cache,
+)
+
+
+def group_layout(sharing_pattern: list[int]) -> list[int]:
+    """[#sub-layers per group] from the sharing pattern (reference base.py:43-57):
+    consecutive equal entries form one KV group."""
+    sizes: list[int] = []
+    for i, target in enumerate(sharing_pattern):
+        if i == 0 or target != sharing_pattern[i - 1]:
+            sizes.append(1)
+        else:
+            sizes[-1] += 1
+    return sizes
+
+
+class KeyValueProjection(nn.Module):
+    """Pre-norm KV projection shared by a group (reference attention/base.py:129-162).
+    Output layout per kv-head: [k_i (D) | v_i (D)]."""
+
+    config: GPTCrossLayerConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden_states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        config = self.config
+        head_dim = config.head_dim
+        num_kv = config.num_key_value_heads
+
+        h = get_norm(config, self.dtype, "ln")(hidden_states)
+        kv = ParameterizedLinear(
+            features=2 * num_kv * head_dim,
+            use_bias=config.add_bias,
+            std=config.initializer_range,
+            kernel_axes=("embed", "kv_heads"),
+            dtype=self.dtype,
+            name="kv_attn",
+        )(h)
+
+        batch, seq = hidden_states.shape[:2]
+        kv = kv.reshape(batch, seq, num_kv, 2 * head_dim)
+        key, value = jnp.split(kv, 2, axis=-1)
+        return key, value
+
+
+class CrossLayerAttention(nn.Module):
+    """Query-only attention against group K/V (reference attention/base.py:17-127)."""
+
+    config: GPTCrossLayerConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        key: jax.Array,
+        value: jax.Array,
+        attention_mask: jax.Array | None,
+        segment_ids: jax.Array | None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None,
+        alibi_bias: jax.Array | None,
+        query_offset: jax.Array | int,
+        deterministic: bool,
+    ) -> jax.Array:
+        config = self.config
+        num_heads = config.n_head
+        head_dim = config.head_dim
+
+        init_method = InitMethod(config.init_method)
+        std = config.initializer_range
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        q_attn = ParameterizedLinear(
+            features=num_heads * head_dim,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "heads"),
+            dtype=self.dtype,
+            name="q_attn",
+        )
+
+        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_proj = ParameterizedLinear(
+            features=config.n_embd,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("heads", "embed"),
+            dtype=self.dtype,
+            name="c_proj",
+        )
+
+        batch, seq = hidden_states.shape[:2]
+        query = q_attn(hidden_states).reshape(batch, seq, num_heads, head_dim)
+        if rope_cos_sin is not None:
+            cos, sin = rope_cos_sin
+            query = apply_rotary_pos_emb(query, cos, sin)
+
+        softmax_scale = get_softmax_scale(config, head_dim)
+
+        dropout_rng = None
+        attn_pdrop = 0.0 if deterministic else config.attn_pdrop
+        if attn_pdrop > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        out = attention_op(
+            query,
+            key,
+            value,
+            implementation=self.attention_implementation,
+            causal=True,
+            softmax_scale=softmax_scale,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            alibi_bias=alibi_bias,
+            softmax_in_fp32=config.attention_softmax_in_fp32,
+            dropout=attn_pdrop,
+            dropout_rng=dropout_rng,
+            query_offset=query_offset,
+        )
+        out = out.reshape(batch, seq, num_heads * head_dim)
+        out = c_proj(out)
+        out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)
+        return out
+
+
+class CrossLayerGroup(nn.Module):
+    """One KV group: shared KeyValueProjection + N query-only sub-layers
+    (reference layer.py:96-190 `GPTCrossLayerBlock` + `CrossLayer`). Signature matches
+    `Block` so `GPTDolomiteModel`'s loop and remat wrapping apply unchanged; the kv_cache
+    slot holds this GROUP's single cache entry."""
+
+    config: GPTCrossLayerConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    num_sublayers: int = 1
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        attention_mask: jax.Array | None = None,
+        segment_ids: jax.Array | None = None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None = None,
+        alibi_bias: jax.Array | None = None,
+        kv_cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        config = self.config
+        m_residual = config.m_residual
+        joint_residual = hidden_states if config.joint_residual_stream else None
+
+        key, value = KeyValueProjection(config=config, dtype=self.dtype, name="kv_proj")(
+            hidden_states
+        )
+        if rope_cos_sin is not None:
+            cos, sin = rope_cos_sin
+            key = apply_rotary_pos_emb(key, cos, sin)
+
+        query_offset = 0
+        if kv_cache is not None:
+            assert cache_index is not None
+            key, value, kv_cache, attention_mask, query_offset = update_kv_cache(
+                key, value, kv_cache, cache_index, attention_mask
+            )
+
+        for local_idx in range(self.num_sublayers):
+            residual = hidden_states
+            h = get_norm(config, self.dtype, f"layers_{local_idx}_ln_1")(hidden_states)
+            attn_out = CrossLayerAttention(
+                config=config,
+                attention_implementation=self.attention_implementation,
+                dtype=self.dtype,
+                name=f"layers_{local_idx}_attn",
+            )(
+                h,
+                key,
+                value,
+                attention_mask,
+                segment_ids,
+                rope_cos_sin,
+                alibi_bias,
+                query_offset,
+                deterministic,
+            )
+            if m_residual is not None:
+                attn_out = attn_out * m_residual
+            hidden_states = residual + attn_out
+            if joint_residual is not None:
+                hidden_states = hidden_states + joint_residual
+
+            residual = hidden_states
+            h = get_norm(config, self.dtype, f"layers_{local_idx}_ln_2")(hidden_states)
+            mlp_out = MLP(config=config, dtype=self.dtype, name=f"layers_{local_idx}_mlp")(
+                h, deterministic=deterministic
+            )
+            if m_residual is not None:
+                mlp_out = mlp_out * m_residual
+            hidden_states = residual + mlp_out
+
+        hidden_states = nn.with_logical_constraint(
+            hidden_states, ("act_batch", "act_seq", "act_embed")
+        )
+        return hidden_states, kv_cache
+
+
+class GPTCrossLayerModel(GPTDolomiteModel):
+    """Decoder stack of KV groups (reference base.py:21-115)."""
+
+    block_cls: type = CrossLayerGroup
+
+    def setup(self) -> None:
+        self._group_sizes = group_layout(self.config.sharing_pattern)
+        super().setup()
+
+    def _make_block(self, cls: type, i: int) -> nn.Module:
+        return cls(
+            config=self.config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            num_sublayers=self._group_sizes[i],
+        )
+
+    @property
+    def num_blocks(self) -> int:
+        return len(group_layout(self.config.sharing_pattern))
+
+
+class GPTCrossLayerForCausalLM(GPTDolomiteForCausalLM):
+    """Causal LM over the cross-layer stack (reference `gpt_crosslayer/main.py`)."""
+
+    base_model_cls: type = GPTCrossLayerModel
+
+    def init_kv_caches(self, batch_size: int, max_length: int, dtype=None) -> list[KVCache]:
+        config = self.config
+        dtype = dtype or self.dtype
+        shape = (batch_size, max_length, config.num_key_value_heads, config.head_dim)
+        n_groups = len(group_layout(config.sharing_pattern))
+        return [
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)} for _ in range(n_groups)
+        ]
+
+
+def convert_gpt_dolomite_to_gpt_crosslayer(
+    config, params: dict, sharing_pattern: list[int] | None = None
+) -> tuple[GPTCrossLayerConfig, dict]:
+    """GPTDolomite flax params -> GPTCrossLayer flax params (reference utils.py:11-141).
+
+    Each layer keeps its query projection / MLP / norms; a group's KV projection takes the
+    PARENT layer's K/V slices of the fused c_attn plus the parent's ln_1 as the kv pre-norm.
+    With the identity sharing pattern the converted model is numerically identical.
+    """
+    import numpy as np
+
+    cl_config = GPTCrossLayerConfig.from_dict(
+        dict(config.to_dict(), model_type="gpt_crosslayer", sharing_pattern=sharing_pattern)
+    )
+    sharing_pattern = cl_config.sharing_pattern
+
+    head_dim = config.head_dim
+    nq = config.n_head * head_dim
+    nkv = config.num_key_value_heads * head_dim
+
+    def to_np(tree):
+        return jax.tree.map(lambda x: np.asarray(x), nn.unbox(tree))
+
+    src = to_np(params)
+    t_src = src["transformer"]
+    t_dst: dict = {}
+    out = {"transformer": t_dst}
+
+    for name in ("wte", "wpe", "ln_f"):
+        if name in t_src:
+            t_dst[name] = t_src[name]
+    if "lm_head" in src:
+        out["lm_head"] = src["lm_head"]
+
+    # map original layer index -> (group index, local index)
+    group_of: dict[int, tuple[int, int]] = {}
+    g = -1
+    local = 0
+    for j, target in enumerate(sharing_pattern):
+        if j == 0 or target != sharing_pattern[j - 1]:
+            g += 1
+            local = 0
+        else:
+            local += 1
+        group_of[j] = (g, local)
+
+    for j in range(config.n_layer):
+        gi, li = group_of[j]
+        h_src = t_src[f"h_{j}"]
+        h_dst = t_dst.setdefault(f"h_{gi}", {})
+
+        c_attn = h_src["attn"]["c_attn"]  # kernel [H, (Hq+2Hkv)*D] flat [Q|K|V]
+        kernel = c_attn["kernel"]
+        q_kernel = kernel[:, :nq]
+        k_kernel = kernel[:, nq : nq + nkv]
+        v_kernel = kernel[:, nq + nkv :]
+
+        attn_dst = {"q_attn": {"kernel": q_kernel}, "c_proj": h_src["attn"]["c_proj"]}
+        if "bias" in c_attn:
+            attn_dst["q_attn"]["bias"] = c_attn["bias"][:nq]
+
+        h_dst[f"layers_{li}_ln_1"] = h_src["ln_1"]
+        h_dst[f"layers_{li}_ln_2"] = h_src["ln_2"]
+        h_dst[f"layers_{li}_attn"] = attn_dst
+        h_dst[f"layers_{li}_mlp"] = h_src["mlp"]
+
+        if sharing_pattern[j] == j:
+            # self-referencing parent supplies its group's kv projection (reference
+            # utils.py:87 `if layer_idx in sharing_pattern`) — the parent need not be the
+            # first layer of its consecutive group (e.g. pattern [0, 2, 2])
+            # kv projection layout per kv-head: [k_i | v_i]
+            hidden = kernel.shape[0]
+            k3 = k_kernel.reshape(hidden, config.num_key_value_heads, head_dim)
+            v3 = v_kernel.reshape(hidden, config.num_key_value_heads, head_dim)
+            kv_kernel = np.concatenate([k3, v3], axis=-1).reshape(hidden, 2 * nkv)
+            kv_proj = {"ln": h_src["ln_1"], "kv_attn": {"kernel": kv_kernel}}
+            if "bias" in c_attn:
+                kb = c_attn["bias"][nq : nq + nkv].reshape(config.num_key_value_heads, head_dim)
+                vb = c_attn["bias"][nq + nkv :].reshape(config.num_key_value_heads, head_dim)
+                kv_proj["kv_attn"]["bias"] = np.concatenate([kb, vb], axis=-1).reshape(-1)
+            h_dst["kv_proj"] = kv_proj
+
+    return cl_config, out

@@ -71,7 +71,7 @@ class GPTDolomiteModel(nn.Module):
         self.drop = nn.Dropout(rate=config.embd_pdrop)
 
         blocks = []
-        for i in range(config.n_layer):
+        for i in range(self.num_blocks):
             cls = self.block_cls
             if self.checkpoint_every and i % self.checkpoint_every == 0:
                 # flax counts the module instance as argument 0; deterministic is arg 8
@@ -89,6 +89,11 @@ class GPTDolomiteModel(nn.Module):
                 rope_scaling=config.rope_scaling,
                 max_position_embeddings=config.n_positions,
             )
+
+    @property
+    def num_blocks(self) -> int:
+        """Block-instance count; cross-layer KV sharing builds one block per KV group."""
+        return self.config.n_layer
 
     def _make_block(self, cls: type, i: int) -> nn.Module:
         # list attribute assignment in setup auto-names these h_0, h_1, ...
@@ -129,7 +134,13 @@ class GPTDolomiteModel(nn.Module):
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
 
-        key_length = seq if kv_caches is None else kv_caches[0]["k"].shape[1]
+        # cache length from the first standard KV cache (RNN hybrids mix cache kinds)
+        key_length = seq
+        if kv_caches is not None:
+            for c in kv_caches:
+                if isinstance(c, dict) and "k" in c:
+                    key_length = c["k"].shape[1]
+                    break
         rope_cos_sin, alibi_bias = compute_position_stuff(
             config,
             position_ids,

@@ -168,6 +168,40 @@ def get_norm(config: CommonConfig, dtype: Dtype, name: str | None = None) -> Nor
     )
 
 
+def get_softmax_scale(config: CommonConfig, head_dim: int) -> float:
+    """attention_multiplier if set, else 1/sqrt(head_dim) when scale_attn_weights, else 1
+    (reference `attention/base.py` / `sdpa.py` scale selection)."""
+    if config.attention_multiplier is not None:
+        return config.attention_multiplier
+    if config.scale_attn_weights:
+        return head_dim**-0.5
+    return 1.0
+
+
+def update_kv_cache(
+    key: jax.Array,
+    value: jax.Array,
+    kv_cache: KVCache,
+    cache_index: jax.Array,
+    attention_mask: jax.Array | None,
+):
+    """Write new K/V at cache_index and return the full-cache views plus a mask that hides
+    not-yet-written slots. Returns (key, value, kv_cache, attention_mask, query_offset)."""
+    seq = key.shape[1]
+    k_cache = jax.lax.dynamic_update_slice(kv_cache["k"], key, (0, cache_index, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(kv_cache["v"], value, (0, cache_index, 0, 0))
+    kv_cache = {"k": k_cache, "v": v_cache}
+
+    cache_len = k_cache.shape[1]
+    valid = jnp.arange(cache_len)[None, :] < (cache_index + seq)
+    attention_mask = (
+        valid.astype(jnp.int32)
+        if attention_mask is None
+        else attention_mask * valid.astype(attention_mask.dtype)
+    )
+    return k_cache, v_cache, kv_cache, attention_mask, cache_index
+
+
 class Attention(nn.Module):
     """Self-attention with fused QKV, RoPE/alibi, KV cache, all head types."""
 
@@ -239,26 +273,11 @@ class Attention(nn.Module):
         if kv_cache is not None:
             # decode: write new K/V at cache_index, attend over the whole cache
             assert cache_index is not None
-            k_cache = jax.lax.dynamic_update_slice(kv_cache["k"], key, (0, cache_index, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(kv_cache["v"], value, (0, cache_index, 0, 0))
-            kv_cache = {"k": k_cache, "v": v_cache}
-            key, value = k_cache, v_cache
-            query_offset = cache_index
-            # mask out not-yet-written cache positions
-            cache_len = k_cache.shape[1]
-            valid = jnp.arange(cache_len)[None, :] < (cache_index + seq)
-            attention_mask = (
-                valid.astype(jnp.int32)
-                if attention_mask is None
-                else attention_mask * valid.astype(attention_mask.dtype)
+            key, value, kv_cache, attention_mask, query_offset = update_kv_cache(
+                key, value, kv_cache, cache_index, attention_mask
             )
 
-        if config.attention_multiplier is not None:
-            softmax_scale = config.attention_multiplier
-        elif config.scale_attn_weights:
-            softmax_scale = head_dim**-0.5
-        else:
-            softmax_scale = 1.0
+        softmax_scale = get_softmax_scale(config, head_dim)
 
         dropout_rng = None
         attn_pdrop = 0.0 if deterministic else config.attn_pdrop

@@ -1,0 +1,177 @@
+"""GPTCrossLayer tests.
+
+Parity: reference `tests/hf_models/single_gpu/gpt_crosslayer_test.py` (attention-impl matrix)
+and the dolomite->crosslayer conversion (utils.py) — with the identity sharing pattern the
+converted model must match the original exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.models import (
+    GPTCrossLayerForCausalLM,
+    convert_gpt_dolomite_to_gpt_crosslayer,
+)
+from dolomite_engine_tpu.models.config import GPTCrossLayerConfig
+from dolomite_engine_tpu.models.gpt_crosslayer import group_layout
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+
+from ..test_commons import assert_allclose, get_dense_test_config, get_dummy_inputs
+
+
+def _cl_config(sharing_pattern=None, **kwargs) -> GPTCrossLayerConfig:
+    return GPTCrossLayerConfig(
+        vocab_size=2048,
+        n_positions=512,
+        n_embd=32,
+        n_layer=4,
+        n_head=4,
+        num_key_value_heads=2,
+        position_embedding_type=kwargs.pop("position_embedding_type", "rope"),
+        activation_function="swiglu",
+        normalization_function="rmsnorm",
+        add_bias=kwargs.pop("add_bias", False),
+        sharing_pattern=sharing_pattern,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        bos_token_id=0,
+        eos_token_id=1,
+        pad_token_id=2,
+        **kwargs,
+    )
+
+
+def test_group_layout():
+    assert group_layout([0, 1, 2, 3]) == [1, 1, 1, 1]
+    assert group_layout([0, 0, 2, 2]) == [2, 2]
+    assert group_layout([0, 0, 0, 3]) == [3, 1]
+
+
+def test_sharing_pattern_validation():
+    _cl_config(sharing_pattern=[0, 2, 2, 2])  # valid: parents 0 and 2 both self-reference
+    with pytest.raises(AssertionError):
+        _cl_config(sharing_pattern=[2, 2, 0, 0])  # decreasing
+    with pytest.raises(AssertionError):
+        _cl_config(sharing_pattern=[0, 0, 1, 1])  # parent 1 not self-referencing
+
+
+@pytest.mark.parametrize("sharing_pattern", [[0, 0, 2, 2], [0, 0, 0, 0], None])
+def test_forward_and_loss(sharing_pattern):
+    config = _cl_config(sharing_pattern=sharing_pattern)
+    model = GPTCrossLayerForCausalLM(config=config)
+    ids, mask = get_dummy_inputs(config)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    out = model.apply(params, ids, attention_mask=mask, compute_loss=True)
+    assert out.logits.shape == (*ids.shape, config.vocab_size)
+    assert np.isfinite(float(out.loss))
+    # parameter sharing: only group parents own a kv projection
+    n_groups = len(group_layout(config.sharing_pattern))
+    kv_projs = [k for k in params["params"]["transformer"] if k.startswith("h_")]
+    assert len(kv_projs) == n_groups
+
+
+def test_conversion_identity_pattern_matches_original():
+    """With sharing_pattern = identity the converted model reproduces GPTDolomite exactly
+    (reference tests the same via convert_gpt_dolomite_to_gpt_crosslayer)."""
+    base_config = get_dense_test_config(
+        "gqa", "rope", activation_function="swiglu", normalization_function="rmsnorm",
+        add_bias=False,
+    )
+    base = GPTDolomiteForCausalLM(config=base_config)
+    ids, mask = get_dummy_inputs(base_config)
+    base_params = base.init(jax.random.PRNGKey(0), ids)
+    base_out = base.apply(base_params, ids, attention_mask=mask)
+
+    cl_config, cl_params = convert_gpt_dolomite_to_gpt_crosslayer(
+        base_config, base_params["params"]
+    )
+    cl_model = GPTCrossLayerForCausalLM(config=cl_config)
+    cl_out = cl_model.apply({"params": cl_params}, ids, attention_mask=mask)
+
+    valid = np.asarray(mask).astype(bool)
+    assert_allclose(
+        np.asarray(cl_out.logits)[valid], np.asarray(base_out.logits)[valid],
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+def test_conversion_shared_pattern_shapes():
+    base_config = get_dense_test_config(
+        "gqa", "rope", activation_function="swiglu", normalization_function="rmsnorm",
+        add_bias=True,
+    )
+    base = GPTDolomiteForCausalLM(config=base_config)
+    ids, _ = get_dummy_inputs(base_config)
+    base_params = base.init(jax.random.PRNGKey(0), ids)
+
+    cl_config, cl_params = convert_gpt_dolomite_to_gpt_crosslayer(
+        base_config, base_params["params"], sharing_pattern=[0, 0, 2, 2]
+    )
+    cl_model = GPTCrossLayerForCausalLM(config=cl_config)
+    out = cl_model.apply({"params": cl_params}, ids)
+    assert np.all(np.isfinite(np.asarray(out.logits)))
+
+    # converted params must be loadable 1:1 into a fresh init's structure
+    fresh = cl_model.init(jax.random.PRNGKey(1), ids)["params"]
+    import flax.linen as nn
+
+    fresh_paths = set(jax.tree_util.tree_leaves_with_path(nn.unbox(fresh), is_leaf=None) and
+                      [jax.tree_util.keystr(p) for p, _ in
+                       jax.tree_util.tree_flatten_with_path(nn.unbox(fresh))[0]])
+    conv_paths = set(jax.tree_util.keystr(p) for p, _ in
+                     jax.tree_util.tree_flatten_with_path(cl_params)[0])
+    assert fresh_paths == conv_paths
+
+
+def test_conversion_parent_not_at_group_start():
+    """Pattern [0, 2, 2, 2] is valid (parent 2 self-references mid-group); the converter must
+    still emit a kv_proj for that group (from the parent layer's c_attn)."""
+    base_config = get_dense_test_config(
+        "gqa", "rope", activation_function="swiglu", normalization_function="rmsnorm",
+        add_bias=False,
+    )
+    base = GPTDolomiteForCausalLM(config=base_config)
+    ids, _ = get_dummy_inputs(base_config)
+    base_params = base.init(jax.random.PRNGKey(0), ids)
+
+    cl_config, cl_params = convert_gpt_dolomite_to_gpt_crosslayer(
+        base_config, base_params["params"], sharing_pattern=[0, 2, 2, 2]
+    )
+    cl_model = GPTCrossLayerForCausalLM(config=cl_config)
+    out = cl_model.apply({"params": cl_params}, ids)
+    assert np.all(np.isfinite(np.asarray(out.logits)))
+
+
+def test_kv_cache_decode_matches_full_forward():
+    config = _cl_config(sharing_pattern=[0, 0, 2, 2])
+    model = GPTCrossLayerForCausalLM(config=config)
+    rs = np.random.RandomState(1)
+    ids = jnp.asarray(rs.randint(0, config.vocab_size, (2, 12)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), ids)
+
+    full = model.apply(params, ids)
+
+    caches = model.init_kv_caches(2, 12)
+    assert len(caches) == 2  # one per group, not per layer
+    prefill = model.apply(params, ids[:, :8], kv_caches=caches, cache_index=jnp.zeros((), jnp.int32))
+    logits = [prefill.logits]
+    caches = prefill.kv_caches
+    for t in range(8, 12):
+        step = model.apply(
+            params, ids[:, t : t + 1], kv_caches=caches, cache_index=jnp.asarray(t, jnp.int32)
+        )
+        caches = step.kv_caches
+        logits.append(step.logits)
+    assert_allclose(jnp.concatenate(logits, axis=1), full.logits, atol=3e-4, rtol=3e-4)
+
+
+def test_joint_residual_stream():
+    config = _cl_config(sharing_pattern=[0, 0, 0, 0], joint_residual_stream=True)
+    model = GPTCrossLayerForCausalLM(config=config)
+    ids, _ = get_dummy_inputs(config)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    out = model.apply(params, ids)
+    assert np.all(np.isfinite(np.asarray(out.logits)))
