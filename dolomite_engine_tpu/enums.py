@@ -56,11 +56,16 @@ class AttentionImplementation(Enum):
       - ``sdpa``: XLA fused `jax.nn.dot_product_attention` (default)
       - ``flash_attention_2``: Pallas flash/splash kernel with segment-id masking
         (this is also the padding-free path: packed sequences + segment ids)
+      - ``ring``: ring attention (context parallelism) over the "sp" mesh axis — exact
+        causal attention on sequence-sharded activations with ppermute'd K/V blocks; falls
+        back to sdpa when the mesh has no sp sharding. Absent in the reference (SURVEY §2.6
+        lists CP as not implemented) — TPU-native extension.
     """
 
     eager = "eager"
     sdpa = "sdpa"
     flash_attention_2 = "flash_attention_2"
+    ring = "ring"
 
 
 class DistributedBackend(Enum):

@@ -313,17 +313,14 @@ def convert_gpt_dolomite_to_gpt_crosslayer(
     if "lm_head" in src:
         out["lm_head"] = src["lm_head"]
 
-    # map original layer index -> (group index, local index)
+    # map original layer index -> (group index, local index), derived from the same
+    # group_layout the model's block construction uses
     group_of: dict[int, tuple[int, int]] = {}
-    g = -1
-    local = 0
-    for j, target in enumerate(sharing_pattern):
-        if j == 0 or target != sharing_pattern[j - 1]:
-            g += 1
-            local = 0
-        else:
-            local += 1
-        group_of[j] = (g, local)
+    j = 0
+    for g, size in enumerate(group_layout(sharing_pattern)):
+        for local in range(size):
+            group_of[j] = (g, local)
+            j += 1
 
     for j in range(config.n_layer):
         gi, li = group_of[j]

@@ -279,14 +279,15 @@ class RNNDolomiteForCausalLM(GPTDolomiteForCausalLM):
         config = self.config
         dtype = dtype or self.dtype
         head_dim = config.n_embd // config.n_head
+        conv_size = DeltaNet.conv_size  # dataclass default, the single source of truth
         caches = []
         for mixer in config.attention_pattern:
             if mixer == "d":
                 caches.append(
                     {
-                        "conv_q": jnp.zeros((batch_size, config.n_embd, 4), dtype),
-                        "conv_k": jnp.zeros((batch_size, config.n_embd, 4), dtype),
-                        "conv_v": jnp.zeros((batch_size, config.n_embd, 4), dtype),
+                        "conv_q": jnp.zeros((batch_size, config.n_embd, conv_size), dtype),
+                        "conv_k": jnp.zeros((batch_size, config.n_embd, conv_size), dtype),
+                        "conv_v": jnp.zeros((batch_size, config.n_embd, conv_size), dtype),
                         "recurrent": jnp.zeros(
                             (batch_size, config.n_head, head_dim, head_dim), dtype
                         ),

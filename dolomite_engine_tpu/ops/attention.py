@@ -167,6 +167,34 @@ def attention(
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
 
+    if implementation == AttentionImplementation.ring:
+        from ..parallel.mesh import MeshManager
+        from .ring_attention import ring_attention_sharded
+
+        use_ring = (
+            MeshManager.is_initialized()
+            and MeshManager.axis_size("sp") > 1
+            and q.shape[1] == k.shape[1]  # no decode-with-cache over the ring
+            and q.shape[1] % MeshManager.axis_size("sp") == 0
+            and attention_mask is None  # padded batches: use packed segment_ids instead
+            and alibi_bias is None
+            and dropout == 0.0
+            and causal
+        )
+        if use_ring:
+            # K/V stay un-repeated: GQA grouping happens inside the ring so ICI moves only
+            # kv heads
+            return ring_attention_sharded(
+                q,
+                k,
+                v,
+                MeshManager.get_mesh(),
+                causal=True,
+                softmax_scale=softmax_scale,
+                segment_ids=segment_ids,
+            )
+        implementation = AttentionImplementation.sdpa
+
     use_flash = (
         implementation == AttentionImplementation.flash_attention_2
         and jax.default_backend() == "tpu"

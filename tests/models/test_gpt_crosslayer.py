@@ -118,9 +118,8 @@ def test_conversion_shared_pattern_shapes():
     fresh = cl_model.init(jax.random.PRNGKey(1), ids)["params"]
     import flax.linen as nn
 
-    fresh_paths = set(jax.tree_util.tree_leaves_with_path(nn.unbox(fresh), is_leaf=None) and
-                      [jax.tree_util.keystr(p) for p, _ in
-                       jax.tree_util.tree_flatten_with_path(nn.unbox(fresh))[0]])
+    fresh_paths = set(jax.tree_util.keystr(p) for p, _ in
+                      jax.tree_util.tree_flatten_with_path(nn.unbox(fresh))[0])
     conv_paths = set(jax.tree_util.keystr(p) for p, _ in
                      jax.tree_util.tree_flatten_with_path(cl_params)[0])
     assert fresh_paths == conv_paths
