@@ -193,6 +193,19 @@ def attention(
                 softmax_scale=softmax_scale,
                 segment_ids=segment_ids,
             )
+        if MeshManager.is_initialized() and MeshManager.axis_size("sp") > 1:
+            # the mesh HAS sequence sharding but this call can't ride the ring — say so
+            # once per trace so the user knows the CP savings aren't happening here
+            import logging
+
+            from ..utils import log_rank_0
+
+            log_rank_0(
+                logging.WARNING,
+                "ring attention fell back to sdpa (requires: no kv cache, no attention_mask "
+                "— use packed segment_ids, no alibi, no dropout, causal, seq divisible by "
+                f"sp={MeshManager.axis_size('sp')})",
+            )
         implementation = AttentionImplementation.sdpa
 
     use_flash = (

@@ -139,18 +139,13 @@ def ring_attention_sharded(
     qkv_spec = P(batch_axes or None, seq_axis, h_ax, None)
     seg_spec = P(batch_axes or None, seq_axis)
 
-    if segment_ids is None:
+    operands = (q, k, v) + (() if segment_ids is None else (segment_ids,))
+    in_specs = (qkv_spec, qkv_spec, qkv_spec) + (() if segment_ids is None else (seg_spec,))
 
-        def body(q, k, v):
-            return ring_attention(q, k, v, seq_axis, causal, softmax_scale)
+    def body(q, k, v, *seg):
+        return ring_attention(
+            q, k, v, seq_axis, causal, softmax_scale,
+            segment_ids_q=seg[0] if seg else None,
+        )
 
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec
-        )(q, k, v)
-
-    def body(q, k, v, seg):
-        return ring_attention(q, k, v, seq_axis, causal, softmax_scale, segment_ids_q=seg)
-
-    return jax.shard_map(
-        body, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec), out_specs=qkv_spec
-    )(q, k, v, segment_ids)
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec)(*operands)

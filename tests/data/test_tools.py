@@ -1,0 +1,92 @@
+"""Dataset tooling tests: preprocess jsonl -> bin/idx -> merge -> read back.
+
+Parity: reference `tests/data/megatron_data_test.py:17-60` covers builder round-trip + shard
+merge; here the actual CLI tools under tools/megatron_dataset are exercised.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[2]
+TOOLS = REPO / "tools" / "megatron_dataset"
+
+
+def _make_tokenizer(tmp_path) -> str:
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<unk>": 0, "<eos>": 1}
+    vocab.update({f"w{i}": i for i in range(2, 100)})
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    tok.save(str(tok_dir / "tokenizer.json"))
+    json.dump(
+        {"tokenizer_class": "PreTrainedTokenizerFast", "eos_token": "<eos>"},
+        open(tok_dir / "tokenizer_config.json", "w"),
+    )
+    return str(tok_dir)
+
+
+def _write_jsonl(path, docs):
+    with open(path, "w") as f:
+        for doc in docs:
+            f.write(json.dumps({"text": doc}) + "\n")
+
+
+def _run(script, *args):
+    subprocess.run(
+        [sys.executable, str(TOOLS / script), *args],
+        check=True,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+def test_preprocess_merge_roundtrip(tmp_path):
+    from dolomite_engine_tpu.data.megatron.indexed_dataset import MMapIndexedDataset
+
+    tok_dir = _make_tokenizer(tmp_path)
+    docs_a = ["w2 w3 w4", "w5 w6"]
+    docs_b = ["w6 w7 w8 w9"]
+    _write_jsonl(tmp_path / "a.jsonl", docs_a)
+    _write_jsonl(tmp_path / "b.jsonl", docs_b)
+
+    _run(
+        "preprocess_data.py",
+        "--input", str(tmp_path / "a.jsonl"),
+        "--tokenizer", tok_dir,
+        "--output-prefix", str(tmp_path / "shard_a"),
+        "--append-eod",
+    )
+    _run(
+        "preprocess_data.py",
+        "--input", str(tmp_path / "b.jsonl"),
+        "--tokenizer", tok_dir,
+        "--output-prefix", str(tmp_path / "shard_b"),
+        "--append-eod",
+    )
+
+    ds_a = MMapIndexedDataset(str(tmp_path / "shard_a_text"))
+    assert len(ds_a) == 2
+    np.testing.assert_array_equal(ds_a[0], [2, 3, 4, 1])  # w2 w3 w4 <eos>
+    np.testing.assert_array_equal(ds_a[1], [5, 6, 1])
+
+    _run(
+        "merge_data.py",
+        "--input-prefixes", str(tmp_path / "shard_a_text"), str(tmp_path / "shard_b_text"),
+        "--output-prefix", str(tmp_path / "merged"),
+    )
+    merged = MMapIndexedDataset(str(tmp_path / "merged"))
+    assert len(merged) == 3
+    np.testing.assert_array_equal(merged[0], [2, 3, 4, 1])
+    np.testing.assert_array_equal(merged[2], [6, 7, 8, 9, 1])
+
+    _run("iterate_preprocessed_data.py", "--path-prefix", str(tmp_path / "merged"))

@@ -1,0 +1,14 @@
+"""Export a dolomite checkpoint to HF format (reference `tools/export_to_hf.py`)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dolomite_engine_tpu.hf_interop import export_to_huggingface  # noqa: E402
+
+load_path = "load/"
+save_path = "save/"
+
+# export to HF llama
+export_to_huggingface(load_path, save_path, model_type="llama")

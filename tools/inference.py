@@ -1,0 +1,31 @@
+"""Single-device generation demo (reference `tools/inference.py`)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dolomite_engine_tpu.enums import Mode  # noqa: E402
+from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning  # noqa: E402
+from dolomite_engine_tpu.parallel.mesh import MeshManager  # noqa: E402
+
+SYSTEM_PROMPT = "<|system|>\nYou are a cautious assistant. You carefully follow instructions."
+USER_PROMPT = "<|user|>\n{value}\n"
+ASSISTANT = "<|assistant|>\n"
+
+text = "def factorial(x):"
+prompt = SYSTEM_PROMPT + USER_PROMPT.format(value=text) + ASSISTANT
+
+model_path = "<path to dolomite format model>"
+
+MeshManager()
+model = ModelWrapperForFinetuning(mode=Mode.inference, model_name=model_path)
+params = model.load_pretrained_params(model_path, MeshManager.get_mesh())
+
+x = model.tokenizer([prompt], return_tensors="np")
+batch = {
+    "input_ids": x["input_ids"].astype("int32"),
+    "attention_mask": x["attention_mask"].astype("int32"),
+}
+texts, _ = model.generate(params, batch, {"max_new_tokens": 100})
+print(prompt + texts[0])

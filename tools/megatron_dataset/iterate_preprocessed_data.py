@@ -1,0 +1,30 @@
+"""Inspect a preprocessed bin/idx dataset (reference
+`tools/megatron_dataset/iterate_preprocessed_data.py`)."""
+
+import os
+import sys
+from argparse import ArgumentParser
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dolomite_engine_tpu.data.megatron.indexed_dataset import MMapIndexedDataset  # noqa: E402
+
+
+def main() -> None:
+    parser = ArgumentParser()
+    parser.add_argument("--path-prefix", type=str, required=True, help="Path without suffix")
+    parser.add_argument("--head", type=int, default=3, help="Print the first N documents")
+    args = parser.parse_args()
+
+    dataset = MMapIndexedDataset(args.path_prefix)
+    total_tokens = int(dataset.index.sequence_lengths.sum())
+    print(f"number of documents in the dataset = {len(dataset)}")
+    print(f"total tokens = {total_tokens}")
+    print(f"token dtype = {dataset.index.dtype.__name__}")
+    for i in range(min(args.head, len(dataset))):
+        doc = dataset[i]
+        print(f"doc[{i}]: len={len(doc)} tokens={doc[:16].tolist()}{'...' if len(doc) > 16 else ''}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Tokenize raw corpora into the mmap bin/idx pretraining format.
+
+Parity: reference `tools/megatron_dataset/preprocess_data.py` — jsonl / jsonl.zst / HF-dataset
+input, multiprocessing tokenizer pool, one MMapIndexedDatasetBuilder per json key, optional
+EOD append, dtype picked from vocab size.
+
+Usage:
+    python tools/megatron_dataset/preprocess_data.py \
+        --input data.jsonl --tokenizer <path> --output-prefix out --append-eod \
+        --workers 4 --chunk-size 64
+"""
+
+import json
+import multiprocessing
+import os
+import sys
+from argparse import ArgumentParser, Namespace
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dolomite_engine_tpu.data.megatron.indexed_dataset import (  # noqa: E402
+    MMapIndexedDatasetBuilder,
+    optimal_dtype,
+)
+
+_ENCODER = None  # per-worker global (initialized in _init_worker)
+
+
+class Encoder:
+    def __init__(self, tokenizer_path: str, json_keys: list[str], append_eod: bool) -> None:
+        self.tokenizer_path = tokenizer_path
+        self.json_keys = json_keys
+        self.append_eod = append_eod
+        self.tokenizer = None
+
+    def _ensure_tokenizer(self):
+        if self.tokenizer is None:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(self.tokenizer_path)
+        return self.tokenizer
+
+    def encode_record(self, data: dict) -> dict[str, list[int]]:
+        tokenizer = self._ensure_tokenizer()
+        ids = {}
+        for key in self.json_keys:
+            document_ids = tokenizer.encode(data[key])
+            if len(document_ids) > 0:
+                if self.append_eod:
+                    document_ids.append(tokenizer.eos_token_id)
+                ids[key] = document_ids
+        return ids
+
+    def encode_json_line(self, json_line: str) -> dict[str, list[int]]:
+        return self.encode_record(json.loads(json_line))
+
+
+def _init_worker(tokenizer_path, json_keys, append_eod):
+    global _ENCODER
+    _ENCODER = Encoder(tokenizer_path, json_keys, append_eod)
+
+
+def _encode_line(line):
+    return _ENCODER.encode_json_line(line)
+
+
+def _encode_record(rec):
+    return _ENCODER.encode_record(rec)
+
+
+def get_args() -> Namespace:
+    parser = ArgumentParser()
+    group = parser.add_argument_group(title="input data")
+    group.add_argument("--input", type=str, required=True, help="Path to input jsonl(.zst) / HF dataset")
+    group.add_argument("--subset", type=str, default=None, help="HF dataset subset/data_dir")
+    group.add_argument("--json-keys", nargs="+", default=["text"], help="keys to extract")
+
+    group = parser.add_argument_group(title="tokenizer")
+    group.add_argument("--tokenizer", type=str, required=True, help="Path to the tokenizer")
+    group.add_argument("--append-eod", action="store_true", help="Append EOD after each document")
+
+    group = parser.add_argument_group(title="output data")
+    group.add_argument("--output-prefix", type=str, required=True, help="Output path without suffix")
+
+    group = parser.add_argument_group(title="runtime")
+    group.add_argument("--workers", type=int, default=1, help="Worker processes")
+    group.add_argument("--chunk-size", type=int, default=32, help="Chunk per worker dispatch")
+    return parser.parse_args()
+
+
+def iterate_input(args: Namespace):
+    """Yields (map_fn, iterable) matched to the input kind."""
+    if args.input.endswith(".jsonl"):
+        assert args.subset is None, "--subset only applies to HF datasets"
+        return _encode_line, open(args.input, encoding="utf-8")
+    if args.input.endswith((".jsonl.zst", ".jsonl.zstd")):
+        assert args.subset is None, "--subset only applies to HF datasets"
+        import io
+        import tempfile
+
+        import zstandard
+
+        outfile = tempfile.TemporaryFile()
+        with open(args.input, "rb") as infile:
+            zstandard.ZstdDecompressor().copy_stream(infile, outfile)
+        outfile.seek(0)
+        return _encode_line, io.TextIOWrapper(outfile, encoding="utf-8")
+
+    from datasets import load_dataset
+
+    ds = load_dataset(args.input, streaming=True, split="train", data_dir=args.subset)
+    return _encode_record, ds
+
+
+def main() -> None:
+    args = get_args()
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+    dtype = optimal_dtype(len(tokenizer))
+
+    map_fn, source = iterate_input(args)
+
+    builders = {
+        key: MMapIndexedDatasetBuilder(f"{args.output_prefix}_{key}.bin", dtype=dtype)
+        for key in args.json_keys
+    }
+
+    init_args = (args.tokenizer, args.json_keys, args.append_eod)
+    if args.workers > 1:
+        pool = multiprocessing.Pool(args.workers, initializer=_init_worker, initargs=init_args)
+        encoded_docs = pool.imap(map_fn, source, args.chunk_size)
+    else:
+        _init_worker(*init_args)
+        encoded_docs = map(map_fn, source)
+
+    n = 0
+    for item in encoded_docs:
+        for key, document in item.items():
+            builders[key].add_item(document)
+            builders[key].end_document()
+        n += 1
+        if n % 10000 == 0:
+            print(f"processed {n} documents", flush=True)
+
+    print(f"Done ({n} documents). Now finalizing.")
+    for key in args.json_keys:
+        builders[key].finalize(f"{args.output_prefix}_{key}.idx")
+
+
+if __name__ == "__main__":
+    main()

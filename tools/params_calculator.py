@@ -1,0 +1,29 @@
+"""Parameter counter without materializing weights (reference `tools/params_calculator.py`
+builds on torch meta device; here `jax.eval_shape` is the native equivalent)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dolomite_engine_tpu.enums import Mode  # noqa: E402
+from dolomite_engine_tpu.model_wrapper import ModelWrapper  # noqa: E402
+
+config = dict(
+    model_type="gpt_dolomite",
+    vocab_size=65024,
+    n_positions=4096,
+    n_embd=8192,
+    n_layer=72,
+    n_head=64,
+    num_key_value_heads=8,
+    n_inner=21888,
+    position_embedding_type="rope",
+    activation_function="swiglu",
+    normalization_function="rmsnorm",
+    attention_head_type="gqa",
+    add_bias=False,
+)
+
+wrapper = ModelWrapper(mode=Mode.inference, pretrained_config=config)
+print("total", f"{wrapper.num_parameters():,}")

@@ -1,0 +1,61 @@
+"""Sharded (tensor-parallel) generation demo.
+
+Parity: reference `tools/tensor_parallel_inference.py:10-22` — NCCL init +
+`GPTDolomiteForCausalLM_TP.from_pretrained` + generate. Under GSPMD there is no `_TP` class:
+the same model runs tensor-parallel by loading params with TP shardings over the mesh.
+
+Run (virtual 8-device CPU example):
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/tensor_parallel_inference.py --model <dolomite checkpoint dir> --tp 8
+"""
+
+import os
+import sys
+from argparse import ArgumentParser
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+
+def main() -> None:
+    parser = ArgumentParser()
+    parser.add_argument("--model", type=str, required=True, help="dolomite checkpoint dir")
+    parser.add_argument("--tp", type=int, default=None, help="tensor parallel size (default: all devices)")
+    parser.add_argument("--prompt", type=str, default="def generate():")
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    args = parser.parse_args()
+
+    from dolomite_engine_tpu.enums import Mode
+    from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    tp = args.tp or jax.device_count()
+    MeshManager(tensor_parallel_size=tp)
+    mesh = MeshManager.get_mesh()
+
+    model = ModelWrapperForFinetuning(
+        mode=Mode.inference,
+        model_name=args.model,
+        tensor_parallel_word_embeddings=True,
+    )
+    # TP-sharded from birth: every parameter is placed per the tp sharding rules, never
+    # materialized whole on one device (the GSPMD analogue of per-rank sharded loading)
+    params = model.load_pretrained_params(args.model, mesh)
+
+    x = model.tokenizer(args.prompt, return_tensors="np")
+    batch = {
+        "input_ids": x["input_ids"].astype("int32"),
+        "attention_mask": x["attention_mask"].astype("int32"),
+    }
+    with mesh:
+        texts, counts = model.generate(
+            params, batch, {"max_new_tokens": args.max_new_tokens}
+        )
+    print(f"[tp={tp}] generated {counts[0]} tokens:")
+    print(args.prompt + texts[0])
+
+
+if __name__ == "__main__":
+    main()
