@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..model_wrapper import ModelWrapper
 from ..parallel.mesh import MeshManager
-from ..parallel.sharding import logical_to_mesh_sharding
+from ..parallel.sharding import logical_to_mesh_sharding, prune_indivisible_shardings
 from ..train_utils import TrainState
 
 
@@ -80,6 +80,7 @@ def get_state_shardings(
         params=param_shardings,
         opt_state=opt_shardings,
     )
+    shardings = prune_indivisible_shardings(nn.unbox(abstract_state), shardings, mesh)
     return abstract_state, shardings
 
 

@@ -24,7 +24,12 @@ from flax import linen as nn
 from ..enums import AttentionImplementation, Mode
 from ..models import config_from_dict, get_model_class
 from ..models.config import CommonConfig
-from ..parallel.sharding import LogicalRules, get_logical_axis_rules, logical_to_mesh_sharding
+from ..parallel.sharding import (
+    LogicalRules,
+    get_logical_axis_rules,
+    logical_to_mesh_sharding,
+    prune_indivisible_shardings,
+)
 from ..utils import log_rank_0, string_to_dtype
 
 
@@ -151,9 +156,10 @@ class ModelWrapper:
         )
 
     def param_shardings(self, mesh, for_optimizer: bool = False):
-        return logical_to_mesh_sharding(
-            self.logical_specs(), mesh, self.sharding_rules(for_optimizer)
-        )
+        boxed = self.abstract_boxed_params()  # one abstract trace serves specs + shapes
+        specs = nn.get_partition_spec({"params": boxed})["params"]
+        shardings = logical_to_mesh_sharding(specs, mesh, self.sharding_rules(for_optimizer))
+        return prune_indivisible_shardings(nn.unbox(boxed), shardings, mesh)
 
     def init_params(self, rng: jax.Array, mesh) -> Any:
         """Sharded-from-birth init: jit with out_shardings so no host copy of the full model

@@ -21,6 +21,8 @@ with `nn.with_logical_constraint`; these rules map logical names -> mesh axes.
 
 from __future__ import annotations
 
+import logging
+
 import jax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -76,9 +78,59 @@ def logical_to_mesh_sharding(logical_spec_tree, mesh: Mesh, rules: LogicalRules)
     return nn.logical_to_mesh_sharding(logical_spec_tree, mesh, rules)
 
 
+def prune_indivisible_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+    """Drop mesh axes from a PartitionSpec entry when they don't divide the dimension evenly.
+
+    Makes every mesh size work (odd device counts, dims smaller than the axis): an indivisible
+    axis falls back to replication for that tensor instead of a GSPMD divisibility error. The
+    reference has the same escape hatch implicitly — FSDP pads, DTensor requires divisibility
+    and simply can't run those shapes."""
+    entries = []
+    dropped: list[str] = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            entries.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept: list[str] = []
+        size = 1
+        for ax in axes:
+            ax_size = mesh.shape[ax]
+            if shape[dim] % (size * ax_size) == 0:
+                kept.append(ax)
+                size *= ax_size
+            else:
+                dropped.append(f"{ax}({ax_size})!|dim{dim}={shape[dim]}")
+        entries.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+    if dropped:
+        # replication instead of sharding can be a large memory regression at scale — say so
+        from ..utils.logger import log_rank_0
+
+        log_rank_0(
+            logging.WARNING,
+            f"sharding fallback: replicating tensor of shape {tuple(shape)} on mesh axes "
+            f"{dropped} (indivisible dimension)",
+        )
+    return PartitionSpec(*entries)
+
+
+def prune_indivisible_shardings(abstract_tree, sharding_tree, mesh: Mesh):
+    """Apply `prune_indivisible_spec` leaf-wise over (ShapeDtypeStruct tree, NamedSharding tree)."""
+    return jax.tree.map(
+        lambda leaf, sh: (
+            NamedSharding(mesh, prune_indivisible_spec(sh.spec, leaf.shape, mesh))
+            if isinstance(sh, NamedSharding)
+            else sh
+        ),
+        abstract_tree,
+        sharding_tree,
+    )
+
+
 def get_abstract_state_shardings(abstract_tree, logical_spec_tree, mesh: Mesh, rules: LogicalRules):
     """Pair an eval_shape tree with shardings derived from its logical specs."""
     shardings = logical_to_mesh_sharding(logical_spec_tree, mesh, rules)
+    shardings = prune_indivisible_shardings(abstract_tree, shardings, mesh)
     return jax.tree.map(
         lambda shape, sharding: jax.ShapeDtypeStruct(shape.shape, shape.dtype, sharding=sharding),
         abstract_tree,
