@@ -39,12 +39,12 @@ def build_mesh_from_args(args) -> Mesh:
 
 
 def get_data_parallel_world_size(args) -> int:
-    """Devices on the data-parallel axes (dp x fsdp) = devices not used by model parallelism.
-    Single source of truth for consumed-samples accounting and loader sharding."""
+    """Devices on the batch axes (dp x fsdp x ep) = devices not used by tensor/sequence model
+    parallelism. "ep" counts as data-parallel: the batch shards over it everywhere except MoE
+    layers, which all_to_all tokens across it (DeepSpeed-style EP-in-DP). Single source of
+    truth for consumed-samples accounting and loader sharding."""
     dist = args.distributed_args
-    model_parallel = max(
-        dist.tensor_parallel_size * dist.context_parallel_size * dist.expert_parallel_size, 1
-    )
+    model_parallel = max(dist.tensor_parallel_size * dist.context_parallel_size, 1)
     return max(jax.device_count() // model_parallel, 1)
 
 

@@ -125,7 +125,10 @@ def train(
     micro_batches_per_step = gradient_accumulation_steps
     batch_iter = infinite_iterator(train_dataloader)
 
-    loss_running_sum, loss_running_count = 0.0, 0
+    # running mean folds EVERY step (reference `train_utils.py:130-141`): accumulate the
+    # device scalar asynchronously, sync to host only at log time
+    loss_running_sum = jnp.zeros((), jnp.float32)
+    loss_running_count = 0
     progress = ProgressBar(starting_iteration, num_training_steps)
 
     global_step = starting_iteration
@@ -142,17 +145,18 @@ def train(
         ):
             state, metrics = train_step(state, batch, step_rng)
 
+        loss_running_sum = loss_running_sum + metrics["loss"]
+        loss_running_count += 1
+
         if global_step % log_interval == 0:
             loss = float(metrics["loss"])
-            loss_running_sum += loss
-            loss_running_count += 1
             track_train_metrics(
                 global_step=global_step,
                 train_loss_step=loss,
                 grad_norm=float(metrics["grad_norm"]),
                 current_lr=float(lr_schedule(global_step)),
                 experiments_tracker=experiments_tracker,
-                loss_running_mean=loss_running_sum / max(loss_running_count, 1),
+                loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
                 step_time=time.perf_counter() - step_start,
             )
 

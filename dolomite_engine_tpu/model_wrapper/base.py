@@ -52,9 +52,11 @@ class ModelWrapper:
         additional_special_tokens: list[str] | None = None,
         neft_alpha: float | None = None,
         trust_remote_code: bool = False,
+        model_kwargs: dict | None = None,
     ) -> None:
         self.mode = mode
         self.model_name = model_name
+        self.model_kwargs = model_kwargs or {}  # extra module fields (e.g. moe_implementation)
         self.dtype = string_to_dtype(dtype)
         self.use_padding_free_transformer = use_padding_free_transformer
         self.tensor_parallel_word_embeddings = tensor_parallel_word_embeddings
@@ -126,6 +128,7 @@ class ModelWrapper:
             attention_implementation=self.attention_implementation,
             dtype=self.dtype,
             checkpoint_every=self.checkpoint_every,
+            **self.model_kwargs,
         )
 
     # ------------------------------------------------------------------ params

@@ -27,6 +27,7 @@ from ..ops.activations import get_activation_function, is_glu
 from ..ops.moe import (
     combine_weights,
     experts_eager,
+    experts_ep_a2a,
     experts_ragged,
     load_balancing_loss,
     route,
@@ -80,6 +81,9 @@ class SparseMoE(nn.Module):
     config: MoEConfig
     dtype: Any = jnp.float32
     moe_implementation: str = "auto"  # eager | scatter | auto (scatter on tpu)
+    # capacity per destination shard in the EP all_to_all path, as a multiple of the even
+    # split; >= ep guarantees droplessness (ops/moe.py experts_ep_a2a)
+    ep_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(
@@ -140,11 +144,41 @@ class SparseMoE(nn.Module):
         b_fc = None if b_fc is None else b_fc.astype(self.dtype)
         b_proj = None if b_proj is None else b_proj.astype(self.dtype)
 
+        from ..parallel.mesh import MeshManager
+
         impl = self.moe_implementation
         if impl == "auto":
             impl = "scatter" if jax.default_backend() == "tpu" else "eager"
+        if MeshManager.is_initialized() and MeshManager.axis_size("ep") > 1:
+            # distributed experts: tokens ride an all_to_all across the "ep" axis; the
+            # single-device paths below would all-gather every expert bank onto every device.
+            # Guard: the shard_map token split needs T divisible by the batch-ish axes product
+            # (abstract init traces with an 8-token dummy; decode batches are arbitrary) —
+            # fall back to the dense paths otherwise (correct, just not dispatched).
+            token_split = (
+                MeshManager.axis_size("dp")
+                * MeshManager.axis_size("fsdp")
+                * MeshManager.axis_size("ep")
+                * MeshManager.axis_size("tp")
+            )
+            if (batch * seq) % token_split == 0:
+                impl = "ep_a2a"
 
-        if impl == "scatter":
+        if impl == "ep_a2a":
+            out = experts_ep_a2a(
+                x.astype(self.dtype),
+                router_weights,
+                selected_experts,
+                w_fc,
+                b_fc,
+                w_proj,
+                b_proj,
+                act,
+                config.num_experts,
+                MeshManager.get_mesh(),
+                capacity_factor=self.ep_capacity_factor,
+            )
+        elif impl == "scatter":
             out = experts_ragged(
                 x.astype(self.dtype),
                 router_weights,
@@ -173,6 +207,7 @@ class SparseMoEBlock(nn.Module):
     attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
     dtype: Any = jnp.float32
     moe_implementation: str = "auto"
+    ep_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(
@@ -216,6 +251,7 @@ class SparseMoEBlock(nn.Module):
             config=config,
             dtype=self.dtype,
             moe_implementation=self.moe_implementation,
+            ep_capacity_factor=self.ep_capacity_factor,
             name="moe",
         )(h, deterministic=deterministic)
         if m_residual is not None:
@@ -233,6 +269,7 @@ class MoEDolomiteModel(GPTDolomiteModel):
 
     block_cls: type = SparseMoEBlock
     moe_implementation: str = "auto"
+    ep_capacity_factor: float = 2.0
 
     def _make_block(self, cls: type, i: int) -> nn.Module:
         return cls(
@@ -240,6 +277,7 @@ class MoEDolomiteModel(GPTDolomiteModel):
             attention_implementation=self.attention_implementation,
             dtype=self.dtype,
             moe_implementation=self.moe_implementation,
+            ep_capacity_factor=self.ep_capacity_factor,
         )
 
 
@@ -249,9 +287,14 @@ class MoEDolomiteForCausalLM(GPTDolomiteForCausalLM):
 
     base_model_cls: type = MoEDolomiteModel
     moe_implementation: str = "auto"
+    ep_capacity_factor: float = 2.0
 
     def _transformer_kwargs(self) -> dict:
-        return dict(super()._transformer_kwargs(), moe_implementation=self.moe_implementation)
+        return dict(
+            super()._transformer_kwargs(),
+            moe_implementation=self.moe_implementation,
+            ep_capacity_factor=self.ep_capacity_factor,
+        )
 
     def compute_aux_loss(
         self,

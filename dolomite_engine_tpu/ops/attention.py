@@ -144,8 +144,46 @@ def _tpu_flash_attention(
             jnp.float32
         )
 
-    out = _fa.flash_attention(qt, kt, vt, ab=ab, segment_ids=seg, causal=causal, sm_scale=softmax_scale)
+    out = _fa.flash_attention(
+        qt,
+        kt,
+        vt,
+        ab=ab,
+        segment_ids=seg,
+        causal=causal,
+        sm_scale=softmax_scale,
+        block_sizes=_flash_block_sizes(q.shape[1], k.shape[1]),
+    )
     return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_block_sizes(q_len: int, kv_len: int):
+    """Explicit kernel tiling: measured on v5e, 512x512 blocks run the fwd+bwd pair ~2.4x
+    faster than the kernel's defaults (whose dkv/dq blocks are tiny) at S=2048, D=128. The
+    kernel asserts block | seq, so pick the largest of 512/256/128 that divides."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    def _pick(length: int) -> int:
+        for block in (512, 256, 128):
+            if length % block == 0:
+                return block
+        return min(128, length)
+
+    bq = _pick(q_len)
+    bk = _pick(kv_len)
+    return _fa.BlockSizes(
+        block_q=bq,
+        block_k_major=bk,
+        block_k=bk,
+        block_b=1,
+        block_q_major_dkv=bq,
+        block_k_major_dkv=bk,
+        block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bk,
+        block_k_dq=bk,
+        block_q_dq=bq,
+    )
 
 
 def attention(
@@ -214,6 +252,7 @@ def attention(
         and dropout == 0.0
         and attention_mask is None
         and q.shape[1] == k.shape[1]  # no decode-with-cache in the kernel path
+        and q.shape[1] % 128 == 0  # kernel tiling requires block | seq
     )
     if use_flash:
         return _tpu_flash_attention(q, k, v, alibi_bias, segment_ids, causal, softmax_scale)

@@ -133,3 +133,148 @@ def combine_weights(
     """Dense [T, E] combine matrix from top-k (weights, indices) — feeds `experts_eager`."""
     one_hot = jax.nn.one_hot(selected_experts, num_experts, dtype=router_weights.dtype)
     return jnp.einsum("tk,tke->te", router_weights, one_hot)
+
+
+def _local_expert_compute(
+    x: jax.Array,
+    expert_ids: jax.Array,
+    w_fc: jax.Array,
+    b_fc: jax.Array | None,
+    w_proj: jax.Array,
+    b_proj: jax.Array | None,
+    act: Callable,
+    num_local_experts: int,
+) -> jax.Array:
+    """Grouped GEMM over rows tagged with a local expert id; id == num_local_experts marks an
+    empty slot (routed to a zero-padded dummy bank so `ragged_dot` group sizes stay exact)."""
+    order = jnp.argsort(expert_ids, stable=True)
+    group_sizes = jnp.bincount(expert_ids, length=num_local_experts + 1).astype(jnp.int32)
+
+    w_fc_pad = jnp.concatenate([w_fc, jnp.zeros_like(w_fc[:1])], axis=0)
+    w_proj_pad = jnp.concatenate([w_proj, jnp.zeros_like(w_proj[:1])], axis=0)
+
+    xs = jnp.take(x, order, axis=0)
+    ids_sorted = jnp.take(expert_ids, order)
+    h = jax.lax.ragged_dot(xs, w_fc_pad, group_sizes)
+    if b_fc is not None:
+        b_fc_pad = jnp.concatenate([b_fc, jnp.zeros_like(b_fc[:1])], axis=0)
+        h = h + jnp.take(b_fc_pad, ids_sorted, axis=0)
+    h = act(h)
+    y = jax.lax.ragged_dot(h, w_proj_pad, group_sizes)
+    if b_proj is not None:
+        b_proj_pad = jnp.concatenate([b_proj, jnp.zeros_like(b_proj[:1])], axis=0)
+        y = y + jnp.take(b_proj_pad, ids_sorted, axis=0)
+    # dummy-slot rows are zero already (zero-padded banks, zero-padded bias); the mask keeps
+    # that invariant explicit rather than depending on the padding
+    y = jnp.where((ids_sorted < num_local_experts)[:, None], y, 0.0)
+
+    # unsort back to slot order
+    return jnp.zeros_like(y).at[order].set(y)
+
+
+def experts_ep_a2a(
+    x: jax.Array,
+    router_weights: jax.Array,
+    selected_experts: jax.Array,
+    w_fc: jax.Array,
+    b_fc: jax.Array | None,
+    w_proj: jax.Array,
+    b_proj: jax.Array | None,
+    act: Callable,
+    num_experts: int,
+    mesh,
+    capacity_factor: float = 2.0,
+    token_axes: tuple[str, ...] = ("dp", "fsdp", "ep", "tp"),
+) -> jax.Array:
+    """Expert-parallel dropful dispatch: `all_to_all` token exchange over the "ep" mesh axis.
+
+    The reference never distributes experts (its ScatterMoE only TP-shards the intermediate dim,
+    `moe_TP/scatter.py:118-123`, and has no all_to_all anywhere — SURVEY §2.6 names real EP as a
+    north-star differentiator). Design: tokens are sharded over every batch-ish axis incl. "tp"
+    (so no rank duplicates expert FLOPs); expert banks are sharded over "ep" (E/ep per device,
+    gathered over fsdp/tp at entry — ZeRO-style gather-on-use). Each device routes its local
+    tokens, packs per-destination send buffers of fixed `capacity` (static shapes for XLA),
+    exchanges them with one `lax.all_to_all`, runs its local experts as a grouped GEMM, and
+    sends results back with a second all_to_all; gates are applied at the source. Tokens beyond
+    capacity are dropped (Switch-Transformer semantics) — `capacity_factor >= ep` guarantees
+    droplessness. The token count must divide by the token_axes product (callers fall back to
+    the dense paths otherwise, models/moe_dolomite.py).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape["ep"]
+    assert num_experts % ep == 0, f"num_experts {num_experts} not divisible by ep {ep}"
+    num_local = num_experts // ep
+
+    def body(x, router_weights, selected_experts, w_fc, b_fc, w_proj, b_proj):
+        tokens_local, d = x.shape
+        top_k = selected_experts.shape[-1]
+        assignments = tokens_local * top_k
+        capacity = min(
+            assignments, max(1, int(capacity_factor * tokens_local * top_k / ep))
+        )
+
+        flat_experts = selected_experts.reshape(-1)  # [A]
+        dest = flat_experts // num_local  # destination ep shard per assignment
+        local_id = flat_experts % num_local
+
+        # slot of each assignment within its destination's buffer (stable sort -> rank in group)
+        order = jnp.argsort(dest, stable=True)
+        sorted_dest = jnp.take(dest, order)
+        first_of_group = jnp.searchsorted(sorted_dest, sorted_dest, side="left")
+        rank_sorted = jnp.arange(assignments) - first_of_group
+        slot = jnp.zeros((assignments,), jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+
+        valid = slot < capacity  # overflow slots scatter out of bounds -> mode="drop"
+        token_index = jnp.arange(assignments) // top_k
+
+        send_x = (
+            jnp.zeros((ep, capacity, d), x.dtype)
+            .at[dest, slot]
+            .set(jnp.take(x, token_index, axis=0), mode="drop")
+        )
+        send_ids = (
+            jnp.full((ep, capacity), num_local, jnp.int32)
+            .at[dest, slot]
+            .set(local_id.astype(jnp.int32), mode="drop")
+        )
+
+        recv_x = jax.lax.all_to_all(send_x, "ep", split_axis=0, concat_axis=0, tiled=True)
+        recv_ids = jax.lax.all_to_all(send_ids, "ep", split_axis=0, concat_axis=0, tiled=True)
+
+        y = _local_expert_compute(
+            recv_x.reshape(ep * capacity, d),
+            recv_ids.reshape(ep * capacity),
+            w_fc,
+            b_fc,
+            w_proj,
+            b_proj,
+            act,
+            num_local,
+        ).reshape(ep, capacity, d)
+
+        back = jax.lax.all_to_all(y, "ep", split_axis=0, concat_axis=0, tiled=True)
+
+        # combine at the source: gather each assignment's result, weight by its gate
+        # (out-of-bounds gathers for dropped slots clamp; the valid mask zeroes them)
+        gathered = back[dest, slot]  # [A, d]
+        gates = router_weights.reshape(-1).astype(gathered.dtype)
+        contrib = gathered * (gates * valid.astype(gathered.dtype))[:, None]
+        return jnp.zeros_like(x).at[token_index].add(contrib)
+
+    t_spec = P(token_axes, None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            t_spec,
+            P(token_axes, None),
+            P(token_axes, None),
+            P("ep", None, None),
+            None if b_fc is None else P("ep", None),
+            P("ep", None, None),
+            None if b_proj is None else P("ep", None),
+        ),
+        out_specs=t_spec,
+        check_vma=False,
+    )(x, router_weights, selected_experts, w_fc, b_fc, w_proj, b_proj)

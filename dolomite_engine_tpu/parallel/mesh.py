@@ -31,8 +31,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 MESH_AXES = ("dp", "fsdp", "sp", "tp", "ep")
 
-# data is sharded over every data-parallel-ish axis so per-device batch stays small
-BATCH_AXES = ("dp", "fsdp")
+# data is sharded over every data-parallel-ish axis so per-device batch stays small.
+# "ep" is batch-parallel outside MoE blocks (DeepSpeed-style EP within the data-parallel
+# dimension): attention/MLP shard the batch over it, MoE layers all_to_all tokens across it.
+BATCH_AXES = ("dp", "fsdp", "ep")
 
 
 class MeshManager:
@@ -115,7 +117,12 @@ class MeshManager:
 
     @staticmethod
     def get_data_parallel_world_size() -> int:
-        return MeshManager.axis_size("dp") * MeshManager.axis_size("fsdp")
+        # ep is batch-parallel outside MoE layers (see BATCH_AXES)
+        return (
+            MeshManager.axis_size("dp")
+            * MeshManager.axis_size("fsdp")
+            * MeshManager.axis_size("ep")
+        )
 
     @staticmethod
     def get_tensor_parallel_world_size() -> int:

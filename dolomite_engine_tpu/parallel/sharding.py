@@ -60,7 +60,7 @@ def get_logical_axis_rules(
         ("experts", "ep"),
         ("expert_mlp", "tp"),
         # activation axes
-        ("act_batch", ("dp", "fsdp")),
+        ("act_batch", ("dp", "fsdp", "ep")),
         ("act_seq", act_seq),
         ("act_embed", None),
         ("act_heads", "tp"),
