@@ -116,7 +116,7 @@ def save_checkpoint(
 
     to_save = state
     if not args.save_args.save_optimizer:
-        to_save = TrainState(step=state.step, params=state.params, opt_state=())
+        to_save = TrainState(step=state.step, params=state.params, opt_state=(), fp8=state.fp8)
 
     checkpointer = ocp.StandardCheckpointer()
     checkpointer.save(os.path.abspath(_state_path(base)), to_save, force=True)
@@ -169,8 +169,7 @@ def _checkpoint_tree_metadata(state_path: str):
     return getattr(tree, "tree", tree)
 
 
-def _checkpoint_tree_keys(state_path: str, subtree: str) -> list:
-    tree = _checkpoint_tree_metadata(state_path)
+def _tree_subtree_keys(tree, subtree: str) -> list:
     node = tree.get(subtree) if isinstance(tree, dict) else getattr(tree, subtree, None)
     if node is None:
         return []
@@ -227,15 +226,23 @@ def load_checkpoint_for_training(
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), state
     )
-    checkpoint_has_optimizer = len(_checkpoint_tree_keys(state_path, "opt_state")) > 0
+    tree_meta = _checkpoint_tree_metadata(state_path)  # one metadata read serves all probes
+    checkpoint_has_optimizer = len(_tree_subtree_keys(tree_meta, "opt_state")) > 0
+    # fp8 state may be absent from the checkpoint (bf16 run / pre-fp8 save) or absent from
+    # the live state (bf16 resume of an fp8 save) — restore it only when both sides have it
+    restore_fp8 = state.fp8 is not None and len(_tree_subtree_keys(tree_meta, "fp8")) > 0
 
     if not load_args.load_optimizer:
         # params-only partial restore; keep the freshly-initialized opt_state
-        restored_sub = _partial_restore(
-            state_path, {"step": abstract.step, "params": abstract.params}
-        )
+        want = {"step": abstract.step, "params": abstract.params}
+        if restore_fp8:
+            want["fp8"] = abstract.fp8
+        restored_sub = _partial_restore(state_path, want)
         restored = TrainState(
-            step=restored_sub["step"], params=restored_sub["params"], opt_state=state.opt_state
+            step=restored_sub["step"],
+            params=restored_sub["params"],
+            opt_state=state.opt_state,
+            fp8=restored_sub.get("fp8", state.fp8),
         )
     else:
         if not checkpoint_has_optimizer:
@@ -243,13 +250,31 @@ def load_checkpoint_for_training(
                 f"checkpoint at {base} was saved with save_optimizer=False; "
                 "resume it with load_args.load_optimizer=false"
             )
-        restored = ocp.StandardCheckpointer().restore(state_path, abstract)
+        if state.fp8 is None or restore_fp8:
+            restored = ocp.StandardCheckpointer().restore(state_path, abstract)
+        else:
+            # checkpoint has no fp8 subtree: restore the rest, keep the fresh fp8 state
+            restored_sub = _partial_restore(
+                state_path,
+                {
+                    "step": abstract.step,
+                    "params": abstract.params,
+                    "opt_state": abstract.opt_state,
+                },
+            )
+            restored = TrainState(
+                step=restored_sub["step"],
+                params=restored_sub["params"],
+                opt_state=restored_sub["opt_state"],
+                fp8=state.fp8,
+            )
 
     if load_args.load_optimizer and not load_args.resume_learning_rate:
         restored = TrainState(
             step=restored.step,
             params=restored.params,
             opt_state=_zero_schedule_step(restored.opt_state),
+            fp8=restored.fp8,
         )
 
     jax_rng = None
@@ -285,7 +310,10 @@ def load_checkpoint_for_training(
     starting_iteration = iteration if load_args.load_starting_iteration else 0
     if not load_args.load_starting_iteration:
         restored = TrainState(
-            step=jnp.zeros_like(restored.step), params=restored.params, opt_state=restored.opt_state
+            step=jnp.zeros_like(restored.step),
+            params=restored.params,
+            opt_state=restored.opt_state,
+            fp8=restored.fp8,
         )
 
     log_rank_0(logging.INFO, f"checkpoint loaded from {base}")

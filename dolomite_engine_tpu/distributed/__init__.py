@@ -60,13 +60,21 @@ def get_state_shardings(
     """
     import jax.numpy as jnp
 
+    from ..ops.fp8 import OWG_COLLECTION
+
     def _abstract_init():
         variables = model.model.init(jax.random.PRNGKey(0), **model.get_dummy_inputs())
         params = variables["params"]
         opt_state = optimizer.init(params)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            fp8=variables.get(OWG_COLLECTION),
+        )
 
-    abstract_state = jax.eval_shape(_abstract_init)  # boxed: needed for partition-spec derivation
+    with model.fp8_scope():
+        abstract_state = jax.eval_shape(_abstract_init)  # boxed: for partition-spec derivation
     logical_specs = nn.get_partition_spec(abstract_state)
 
     param_shardings = logical_to_mesh_sharding(
@@ -75,10 +83,13 @@ def get_state_shardings(
     opt_shardings = logical_to_mesh_sharding(
         logical_specs.opt_state, mesh, model.sharding_rules(for_optimizer=True)
     )
+    replicated = NamedSharding(mesh, PartitionSpec())
     shardings = TrainState(
-        step=NamedSharding(mesh, PartitionSpec()),
+        step=replicated,
         params=param_shardings,
         opt_state=opt_shardings,
+        # fp8 scales/amax histories are small per-tensor stats -> replicate
+        fp8=jax.tree.map(lambda _: replicated, nn.unbox(abstract_state.fp8)),
     )
     shardings = prune_indivisible_shardings(nn.unbox(abstract_state), shardings, mesh)
     return abstract_state, shardings
@@ -93,15 +104,22 @@ def create_sharded_train_state(
     """Initialize the TrainState sharded-from-birth; returns (state, shardings)."""
     import jax.numpy as jnp
 
+    from ..ops.fp8 import OWG_COLLECTION
+
     _, shardings = get_state_shardings(model, optimizer, mesh)
 
     def _init():
         variables = model.model.init(rng, **model.get_dummy_inputs())
         params = nn.unbox(variables["params"])  # runtime trees are unboxed (orbax-serializable)
         opt_state = optimizer.init(params)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            fp8=nn.unbox(variables.get(OWG_COLLECTION)),
+        )
 
-    with mesh:
+    with mesh, model.fp8_scope():
         state = jax.jit(_init, out_shardings=shardings)()
     return state, shardings
 

@@ -99,9 +99,9 @@ def train(
     save_interval = args.save_args.save_interval
     log_interval = args.logging_args.log_interval
 
-    def loss_fn(params, micro_batch, rng):
+    def loss_fn(params, micro_batch, rng, fp8_state=None):
         rngs = None if rng is None else {"dropout": rng, "neft": rng}
-        return model.loss(params, micro_batch, rngs=rngs, train=True)
+        return model.loss(params, micro_batch, rngs=rngs, train=True, fp8_state=fp8_state)
 
     train_step = jax.jit(
         make_train_step(
@@ -113,7 +113,11 @@ def train(
         donate_argnums=(0,),
     )
     eval_step = jax.jit(
-        make_eval_step(lambda params, batch, rng: model.loss(params, batch, rngs=None, train=False))
+        make_eval_step(
+            lambda params, batch, rng, fp8_state=None: model.loss(
+                params, batch, rngs=None, train=False, fp8_state=fp8_state
+            )
+        )
     )
 
     if jax_rng is None:
@@ -198,14 +202,16 @@ def evaluate(
     if eval_step is None:
         eval_step = jax.jit(
             make_eval_step(
-                lambda params, batch, rng: model.loss(params, batch, rngs=None, train=False)
+                lambda params, batch, rng, fp8_state=None: model.loss(
+                    params, batch, rngs=None, train=False, fp8_state=fp8_state
+                )
             )
         )
 
     loss_sum, count = 0.0, 0
     for batch in val_dataloader:
         batch = {k: v for k, v in batch.items() if v is not None}
-        loss_sum += float(eval_step(state.params, batch))
+        loss_sum += float(eval_step(state.params, batch, state.fp8))
         count += 1
     if count == 0:
         return None

@@ -57,7 +57,10 @@ class ModelWrapper:
         self.mode = mode
         self.model_name = model_name
         self.model_kwargs = model_kwargs or {}  # extra module fields (e.g. moe_implementation)
-        self.dtype = string_to_dtype(dtype)
+        # fp8 = bf16 compute + delayed-scaling fp8 dots in the linears (ops/fp8.py; reference
+        # distributed/fp8/ selects TE/MS-AMP from MixedPrecisionArgs the same way)
+        self.use_fp8 = dtype == "fp8"
+        self.dtype = jnp.bfloat16 if self.use_fp8 else string_to_dtype(dtype)
         self.use_padding_free_transformer = use_padding_free_transformer
         self.tensor_parallel_word_embeddings = tensor_parallel_word_embeddings
         self.sequence_parallel = sequence_parallel
@@ -121,6 +124,23 @@ class ModelWrapper:
             except Exception as e:  # tokenizer is optional for pretraining on token bins
                 log_rank_0(logging.WARNING, f"could not load tokenizer '{name}': {e}")
 
+    def fp8_scope(self):
+        """Context manager enabling this model's fp8 mode for the traces inside it
+        (several wrappers with different dtypes can coexist in one process, e.g. tests)."""
+        from ..ops.fp8 import fp8_scope
+
+        return fp8_scope(self.use_fp8)
+
+    def variables(self, params, fp8_state=None) -> dict:
+        """Assemble the apply() variable dict; fp8 delayed-scaling state rides its own
+        collection (ops/fp8.py OWG_COLLECTION)."""
+        variables = {"params": params}
+        if fp8_state is not None:
+            from ..ops.fp8 import OWG_COLLECTION
+
+            variables[OWG_COLLECTION] = fp8_state
+        return variables
+
     def _setup_model(self) -> None:
         model_cls = get_model_class(self.model_type)
         self.model: nn.Module = model_cls(
@@ -137,9 +157,10 @@ class ModelWrapper:
 
     def abstract_boxed_params(self):
         """Shape/dtype tree with flax Partitioned boxes (for logical-spec derivation)."""
-        return jax.eval_shape(
-            lambda: self.model.init(jax.random.PRNGKey(0), **self.get_dummy_inputs())
-        )["params"]
+        with self.fp8_scope():
+            return jax.eval_shape(
+                lambda: self.model.init(jax.random.PRNGKey(0), **self.get_dummy_inputs())
+            )["params"]
 
     def abstract_params(self):
         """Unboxed shape/dtype tree (reference's meta-device init, base.py:210-230). Runtime
@@ -172,7 +193,7 @@ class ModelWrapper:
         def _init():
             return nn.unbox(self.model.init(rng, **self.get_dummy_inputs())["params"])
 
-        with mesh:
+        with mesh, self.fp8_scope():
             return jax.jit(_init, out_shardings=shardings)()
 
     # ------------------------------------------------------------------ io

@@ -78,15 +78,23 @@ class ModelWrapperForPretraining(ModelWrapper):
             "segment_ids": segment_ids,
         }
 
-    def loss(self, params, text: jax.Array, rngs: dict | None = None, train: bool = True):
+    def loss(
+        self,
+        params,
+        text: jax.Array,
+        rngs: dict | None = None,
+        train: bool = True,
+        fp8_state=None,
+    ):
         """Scalar LM loss (+ MoE aux loss folded in when the model emits one)."""
         batch = self.prepare_inputs_and_labels(text)
-        output = self.model.apply(
-            {"params": params},
-            deterministic=not train,
-            rngs=rngs,
-            **batch,
-        )
+        with self.fp8_scope():
+            output = self.model.apply(
+                self.variables(params, fp8_state),
+                deterministic=not train,
+                rngs=rngs,
+                **batch,
+            )
         # output.loss already includes the scaled router aux loss (models/gpt_dolomite.py
         # compute_aux_loss hook) — do not add it again
         return output.loss
@@ -98,7 +106,14 @@ class ModelWrapperForFinetuning(ModelWrapper):
     `data/utils.py collate_fn`. The reference's TP broadcast of batches (lines 28-100) is
     unnecessary under SPMD data feed."""
 
-    def loss(self, params, batch: dict, rngs: dict | None = None, train: bool = True):
+    def loss(
+        self,
+        params,
+        batch: dict,
+        rngs: dict | None = None,
+        train: bool = True,
+        fp8_state=None,
+    ):
         inputs = {
             "input_ids": batch["input_ids"],
             "attention_mask": batch.get("attention_mask"),
@@ -112,12 +127,13 @@ class ModelWrapperForFinetuning(ModelWrapper):
             # added to input embeddings; implemented via the models' embedding_noise rng hook.
             rngs = dict(rngs or {})
             rngs.setdefault("neft", jax.random.PRNGKey(0))
-        output = self.model.apply(
-            {"params": params},
-            deterministic=not train,
-            rngs=rngs,
-            **inputs,
-        )
+        with self.fp8_scope():
+            output = self.model.apply(
+                self.variables(params, fp8_state),
+                deterministic=not train,
+                rngs=rngs,
+                **inputs,
+            )
         # output.loss already includes the scaled router aux loss (models/gpt_dolomite.py
         # compute_aux_loss hook) — do not add it again
         return output.loss

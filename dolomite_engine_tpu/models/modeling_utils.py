@@ -64,7 +64,19 @@ class ParameterizedLinear(nn.Module):
             (x.shape[-1], self.features),
             jnp.float32,
         )
-        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        from ..ops.fp8 import fp8_enabled, make_fp8_dot
+
+        if fp8_enabled():
+            # e4m3 fwd / e5m2 grad delayed-scaling dot (ops/fp8.py; reference
+            # distributed/fp8/nv_te.py swaps nn.Linear for te.Linear to the same effect)
+            dot = make_fp8_dot()
+            y = dot(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                (((x.ndim - 1,), (0,)), ((), ())),
+            )
+        else:
+            y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
         if self.use_bias:
             bias = self.param(
                 "bias",

@@ -1,0 +1,48 @@
+"""fp8 training with delayed scaling.
+
+Parity: reference `dolomite_engine/distributed/fp8/` — Transformer Engine module swap +
+`te.fp8_autocast(DelayedScaling)` (`nv_te.py:16-44`) and MS-AMP init (`ms_amp.py:11-13`),
+selected by `MixedPrecisionArgs` (`arguments.py:268-281`). TPU design: no module swap — when
+fp8 is enabled, `ParameterizedLinear` routes its matmul through flax's `Fp8DotGeneralOp`
+(e4m3 forward / e5m2 gradient, per-tensor delayed scaling from an amax history), which XLA
+lowers to native fp8 dots where the hardware supports them and emulates elsewhere (CPU tests).
+
+The quantization state (scales + amax histories) lives in flax's `_overwrite_with_gradient`
+collection: its "gradient" from the custom_vjp IS the next-step state, so the train step
+carries it on `TrainState.fp8` and overwrites it instead of feeding it to the optimizer
+(`train_utils.make_train_step`).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+OWG_COLLECTION = "_overwrite_with_gradient"
+
+_FP8_ENABLED = False
+
+
+@contextmanager
+def fp8_scope(enabled: bool):
+    """Scoped fp8 switch around model traces (the TPU analogue of `te.fp8_autocast`,
+    reference `nv_te.py:16-44`). Scoping — rather than a sticky global — keeps raw
+    flax-module usage (tests, generation) unaffected by an unrelated wrapper's dtype."""
+    global _FP8_ENABLED
+    previous = _FP8_ENABLED
+    _FP8_ENABLED = enabled
+    try:
+        yield
+    finally:
+        _FP8_ENABLED = previous
+
+
+def fp8_enabled() -> bool:
+    return _FP8_ENABLED
+
+
+def make_fp8_dot(name: str = "fp8_dot"):
+    """A flax submodule implementing fp8 dot_general with delayed scaling (direct fp8 dots;
+    the qdq Fp8DotGeneralOp variant is deprecated)."""
+    from flax.linen import fp8_ops
+
+    return fp8_ops.Fp8DirectDotGeneralOp(name=name)

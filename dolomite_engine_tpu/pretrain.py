@@ -90,7 +90,7 @@ def evaluate(
                 batch = next(loader)
             except StopIteration:
                 break
-            loss_sum += float(eval_step_fn(state.params, batch["text"]))
+            loss_sum += float(eval_step_fn(state.params, batch["text"], state.fp8))
             count += 1
         if count == 0:
             continue
@@ -143,13 +143,15 @@ def train(
         gradient_checkpointing_args=args.distributed_args.gradient_checkpointing_args,
     )
 
-    def loss_fn(params, text, rng):
+    def loss_fn(params, text, rng, fp8_state=None):
         rngs = None if rng is None else {"dropout": rng}
-        return model.loss(params, text, rngs=rngs, train=True)
+        return model.loss(params, text, rngs=rngs, train=True, fp8_state=fp8_state)
 
     train_step = jax.jit(
         make_train_step(
-            lambda params, micro, rng: loss_fn(params, micro["text"], rng),
+            lambda params, micro, rng, fp8_state=None: loss_fn(
+                params, micro["text"], rng, fp8_state
+            ),
             optimizer,
             gradient_accumulation_steps=gradient_accumulation_steps,
             gradient_clipping=args.training_parameters.gradient_clipping,
@@ -157,7 +159,11 @@ def train(
         donate_argnums=(0,),
     )
     eval_step_fn = jax.jit(
-        make_eval_step(lambda params, text, rng: model.loss(params, text, rngs=None, train=False))
+        make_eval_step(
+            lambda params, text, rng, fp8_state=None: model.loss(
+                params, text, rngs=None, train=False, fp8_state=fp8_state
+            )
+        )
     )
 
     if jax_rng is None:

@@ -30,6 +30,9 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # fp8 delayed-scaling state (scales + amax histories, ops/fp8.py). None when fp8 is off.
+    # Updated by gradient OVERWRITE, never by the optimizer.
+    fp8: Any = None
 
 
 def clip_grad_norm(grads, max_norm: float | None):
@@ -56,41 +59,56 @@ def make_train_step(
     """
 
     def train_step(state: TrainState, batch, rng: jax.Array):
-        def micro_loss(params, micro_batch, micro_rng):
+        use_fp8 = state.fp8 is not None
+
+        def micro_loss(params, fp8_state, micro_batch, micro_rng):
+            if use_fp8:
+                return loss_fn(params, micro_batch, micro_rng, fp8_state=fp8_state)
             return loss_fn(params, micro_batch, micro_rng)
 
-        grad_fn = jax.value_and_grad(micro_loss)
+        # fp8 state is differentiated too: its "gradient" is the NEXT delayed-scaling state
+        # (flax overwrite-with-gradient contract, ops/fp8.py) — overwritten, never optimized
+        grad_fn = jax.value_and_grad(micro_loss, argnums=(0, 1) if use_fp8 else 0)
 
+        new_fp8 = state.fp8
         if gradient_accumulation_steps == 1:
             micro = jax.tree.map(lambda x: x[0], batch)
-            loss, grads = grad_fn(state.params, micro, rng)
+            loss, grads = grad_fn(state.params, state.fp8, micro, rng)
+            if use_fp8:
+                grads, new_fp8 = grads
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         else:
 
             def accum_fn(carry, xs):
-                grads_acc, loss_acc = carry
+                grads_acc, loss_acc, fp8_carry = carry
                 micro_batch, micro_rng = xs
-                loss, grads = grad_fn(state.params, micro_batch, micro_rng)
+                # thread the scaling state through the micro-steps so every micro-batch's
+                # amax observation enters the history (not just the last one's)
+                loss, grads = grad_fn(state.params, fp8_carry, micro_batch, micro_rng)
+                if use_fp8:
+                    grads, fp8_carry = grads
                 grads_acc = jax.tree.map(
                     lambda a, g: a + g.astype(jnp.float32) / gradient_accumulation_steps,
                     grads_acc,
                     grads,
                 )
-                return (grads_acc, loss_acc + loss / gradient_accumulation_steps), None
+                return (grads_acc, loss_acc + loss / gradient_accumulation_steps, fp8_carry), None
 
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
             rngs = jax.random.split(rng, gradient_accumulation_steps)
-            (grads, loss), _ = jax.lax.scan(
-                accum_fn, (zero_grads, jnp.zeros((), jnp.float32)), (batch, rngs)
+            (grads, loss, new_fp8), _ = jax.lax.scan(
+                accum_fn, (zero_grads, jnp.zeros((), jnp.float32), state.fp8), (batch, rngs)
             )
 
         grads, grad_norm = clip_grad_norm(grads, gradient_clipping)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
-        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state, fp8=new_fp8
+        )
         metrics = {"loss": loss, "grad_norm": grad_norm}
         return new_state, metrics
 
@@ -98,7 +116,9 @@ def make_train_step(
 
 
 def make_eval_step(loss_fn: Callable):
-    def eval_step(params, batch):
+    def eval_step(params, batch, fp8_state=None):
+        if fp8_state is not None:
+            return loss_fn(params, batch, None, fp8_state)
         return loss_fn(params, batch, None)
 
     return eval_step
