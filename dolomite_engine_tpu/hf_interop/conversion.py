@@ -554,8 +554,11 @@ _MODEL_EXPORT_FUNCTIONS = {
 
 
 def import_from_huggingface(pretrained_model_name_or_path: str, save_path: str) -> None:
-    """Reference `model_conversion/__init__.py:19-27`. Local checkpoint dirs only (zero-egress
-    design: hub models must be downloaded out-of-band)."""
+    """Reference `model_conversion/__init__.py:19-27`; hub ids are snapshot-downloaded first
+    (reference builds on utils/hf_hub.py the same way)."""
+    from ..utils.hf_hub import resolve_model_path
+
+    pretrained_model_name_or_path = resolve_model_path(pretrained_model_name_or_path)
     model_type = _read_config(pretrained_model_name_or_path)["model_type"]
     if model_type not in _MODEL_IMPORT_FUNCTIONS:
         raise NotImplementedError(f"the current model_type ({model_type}) is not yet supported")

@@ -57,6 +57,18 @@ class ModelWrapper:
         self.mode = mode
         self.model_name = model_name
         self.model_kwargs = model_kwargs or {}  # extra module fields (e.g. moe_implementation)
+
+        # Encoder-decoder is NOT implemented: the model registry (models/__init__.py) is
+        # decoder-only. The reference finetunes AutoModelForSeq2SeqLM
+        # (model_wrapper/base.py:42-83); here a seq2seq config must fail loudly rather than
+        # silently train a causal LM. The data layer's is_encoder_decoder plumbing
+        # (data/base.py) is kept so the input/output formatting parity tests still cover it.
+        if model_class == "AutoModelForSeq2SeqLM":
+            raise NotImplementedError(
+                "model_class=AutoModelForSeq2SeqLM (encoder-decoder) is not supported by "
+                "dolomite_engine_tpu; only decoder-only (AutoModelForCausalLM) model families "
+                "are registered. Use the reference engine for seq2seq finetuning."
+            )
         # fp8 = bf16 compute + delayed-scaling fp8 dots in the linears (ops/fp8.py; reference
         # distributed/fp8/ selects TE/MS-AMP from MixedPrecisionArgs the same way)
         self.use_fp8 = dtype == "fp8"
@@ -92,15 +104,32 @@ class ModelWrapper:
             import json
             import os
 
-            config_path = os.path.join(model_name, "config.json")
-            if os.path.isfile(config_path):
-                with open(config_path) as f:
-                    self.config = config_from_dict(json.load(f))
-            else:
+            from ..models import is_custom_model
+            from ..utils.hf_hub import resolve_model_path
+
+            # hub ids resolve to a local snapshot dir (reference utils/hf_hub.py:8-29).
+            # Config first: model_type must be a dolomite family BEFORE pulling GBs of
+            # weights — a plain HF repo (llama, mixtral, ...) needs conversion, not loading
+            config_dir = resolve_model_path(model_name, config_only=True)
+            config_path = os.path.join(config_dir, "config.json")
+            if not os.path.isfile(config_path):
+                raise ValueError(f"no config.json in resolved checkpoint dir '{config_dir}'")
+            with open(config_path) as f:
+                config_dict = json.load(f)
+
+            model_type = config_dict.get("model_type")
+            if not is_custom_model(model_type):
                 raise ValueError(
-                    f"model_name '{model_name}' is not a local checkpoint directory; "
-                    "import HF hub models with hf_interop.import_from_huggingface first"
+                    f"model_name '{model_name}' has model_type '{model_type}', which is not a "
+                    "dolomite model family; convert it first with "
+                    "hf_interop.import_from_huggingface(model_name, save_path)"
                 )
+
+            # now safe to fetch the full snapshot; tokenizer + safetensors loading see only
+            # the local path from here on
+            model_name = resolve_model_path(model_name)
+            self.model_name = model_name
+            self.config = config_from_dict(config_dict)
         self.model_type = self.config.model_type
 
     def _setup_tokenizer(

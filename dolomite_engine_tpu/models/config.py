@@ -52,10 +52,23 @@ class CommonConfig:
     init_method: str = "normal"
     upcast_logits_for_loss: bool = False
     tie_word_embeddings: bool = True
+    # TPU-only addition (no reference counterpart): compute the LM-head loss chunked along the
+    # sequence axis without materializing [B, S, V] logits (ops/loss.py
+    # fused_linear_cross_entropy). Training-only; requires tie_word_embeddings. The full-logits
+    # tensor is the largest single allocation in a train step at 50k vocab.
+    fused_lm_head_loss: bool = False
+    loss_chunk_size: int = 256
 
     def __post_init__(self) -> None:
         if self.n_inner is None:
             self.n_inner = 4 * self.n_embd
+
+        if self.fused_lm_head_loss and not self.tie_word_embeddings:
+            raise ValueError(
+                "fused_lm_head_loss requires tie_word_embeddings (the chunked loss reads the "
+                "tied embedding table; an untied lm_head would silently fall back to "
+                "materializing full logits)"
+            )
 
         if self.attention_multiplier is not None:
             assert self.scale_attn_weights

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..enums import AttentionImplementation
-from ..ops.loss import causal_lm_loss
+from ..ops.loss import causal_lm_loss, derive_causal_labels, fused_linear_cross_entropy
 from ..ops.rope import RoPEParams
 from .config import CommonConfig
 from .enums import PositionEmbeddingType
@@ -226,11 +226,40 @@ class GPTDolomiteForCausalLM(nn.Module):
             inputs_embeds=inputs_embeds,
         )
 
+        want_loss = compute_loss or labels is not None
+        use_fused = (
+            want_loss
+            and self.config.fused_lm_head_loss
+            and self.config.tie_word_embeddings
+            and kv_caches is None
+        )
+
+        if use_fused:
+            # chunked LM-head matmul + CE; never materializes [B, S, V] logits (ops/loss.py)
+            fl_labels = (
+                labels
+                if labels is not None
+                else derive_causal_labels(input_ids, attention_mask, segment_ids)
+            )
+            loss = fused_linear_cross_entropy(
+                hidden_states,
+                self.transformer.wte.embedding_table(),
+                fl_labels,
+                chunk_size=self.config.loss_chunk_size,
+                upcast=self.config.upcast_logits_for_loss,
+                logit_scale=None if self.config.m_width is None else 1.0 / self.config.m_width,
+                compute_dtype=self.dtype,
+            )
+            aux_loss = self.compute_aux_loss(extras, attention_mask, segment_ids)
+            if aux_loss is not None:
+                loss = loss + aux_loss
+            return CausalLMOutput(logits=None, loss=loss, kv_caches=new_caches, aux_loss=aux_loss)
+
         logits = self.compute_logits(hidden_states)
 
         loss = None
         aux_loss = None
-        if compute_loss or labels is not None:
+        if want_loss:
             loss = causal_lm_loss(
                 logits,
                 input_ids,

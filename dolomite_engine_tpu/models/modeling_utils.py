@@ -135,10 +135,15 @@ class ParameterizedEmbedding(nn.Module):
 
     def attend(self, x: jax.Array) -> jax.Array:
         """Tied LM head: x @ embedding.T (vocab-parallel when "vocab" -> tp)."""
+        embedding = self.embedding_table()
+        return jnp.dot(x.astype(self.dtype), embedding.astype(self.dtype).T)
+
+    def embedding_table(self) -> jax.Array:
+        """The raw [V, H] table (for the fused LM-head loss, ops/loss.py)."""
         embedding = self.get_variable("params", "embedding")
         if hasattr(embedding, "unbox"):
             embedding = embedding.unbox()
-        return jnp.dot(x.astype(self.dtype), embedding.astype(self.dtype).T)
+        return embedding
 
 
 class Norm(nn.Module):

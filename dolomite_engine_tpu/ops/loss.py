@@ -45,6 +45,32 @@ def cross_entropy_loss(
     return loss_sum, num_tokens
 
 
+def derive_causal_labels(
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Next-token labels: `input_ids` shifted left by one. Positions are IGNORE_INDEX when:
+    the shifted-out last position, padding (attention_mask == 0 / segment 0), or a document
+    boundary (segment of label != segment of input — the `reset_attention_mask` doc isolation).
+    """
+    labels = jnp.concatenate(
+        [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1
+    )
+    if attention_mask is not None:
+        shifted_mask = jnp.concatenate(
+            [attention_mask[:, 1:], jnp.zeros_like(attention_mask[:, :1])], axis=1
+        )
+        labels = jnp.where(shifted_mask.astype(bool), labels, IGNORE_INDEX)
+    if segment_ids is not None:
+        next_seg = jnp.concatenate(
+            [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+        )
+        valid = (next_seg == segment_ids) & (segment_ids != 0)
+        labels = jnp.where(valid, labels, IGNORE_INDEX)
+    return labels
+
+
 def causal_lm_loss(
     logits: jax.Array,
     input_ids: jax.Array,
@@ -53,29 +79,69 @@ def causal_lm_loss(
     segment_ids: jax.Array | None = None,
     labels: jax.Array | None = None,
 ) -> jax.Array:
-    """Mean next-token CE over valid positions.
-
-    If `labels` is None, labels are `input_ids` shifted left by one. Positions are dropped when:
-    the shifted-out last position, padding (attention_mask == 0 / segment 0), or a document
-    boundary (segment of label != segment of input — the `reset_attention_mask` doc isolation).
-    """
+    """Mean next-token CE over valid positions (labels derived per `derive_causal_labels`)."""
     if labels is None:
-        labels = jnp.concatenate(
-            [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1
-        )
-        if attention_mask is not None:
-            shifted_mask = jnp.concatenate(
-                [attention_mask[:, 1:], jnp.zeros_like(attention_mask[:, :1])], axis=1
-            )
-            labels = jnp.where(shifted_mask.astype(bool), labels, IGNORE_INDEX)
-        if segment_ids is not None:
-            next_seg = jnp.concatenate(
-                [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
-            )
-            valid = (next_seg == segment_ids) & (segment_ids != 0)
-            labels = jnp.where(valid, labels, IGNORE_INDEX)
+        labels = derive_causal_labels(input_ids, attention_mask, segment_ids)
 
     loss_sum, num_tokens = cross_entropy_loss(logits, labels, upcast=upcast)
+    return loss_sum / jnp.maximum(num_tokens, 1.0)
+
+
+def fused_linear_cross_entropy(
+    hidden: jax.Array,
+    embedding: jax.Array,
+    labels: jax.Array,
+    *,
+    chunk_size: int = 256,
+    upcast: bool = True,
+    logit_scale: float | None = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """LM-head matmul + CE without ever materializing the [B, S, V] logits.
+
+    The sequence axis is cut into chunks of `chunk_size`; a `lax.scan` with a rematerialized
+    body computes each chunk's logits ([B, chunk, V]), reduces them to (loss_sum, count), and
+    discards them — backward recomputes per chunk. Peak logits memory drops S/chunk_size-fold
+    (at seq 2048 / vocab 50k the full tensor is the single largest allocation in a train step).
+    The reference has no counterpart (it materializes logits and calls F.cross_entropy,
+    `model_wrapper/pretraining.py:89-127`); this is the TPU/HBM-side answer to that cost.
+
+    hidden: [B, S, H]; embedding: [V, H] (tied-embedding layout); labels: [B, S] with
+    IGNORE_INDEX. Chunking is along sequence, so dp/fsdp/ep batch sharding is untouched.
+    """
+    from flax import linen as nn
+
+    B, S, H = hidden.shape
+    chunk_size = min(chunk_size, S)
+    if S % chunk_size != 0:
+        # pad the sequence up to a chunk multiple; padded positions carry IGNORE_INDEX labels
+        # so they contribute nothing to loss_sum/num_tokens
+        pad = chunk_size - S % chunk_size
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=IGNORE_INDEX)
+        S += pad
+    n_chunks = S // chunk_size
+
+    hidden_c = hidden.reshape(B, n_chunks, chunk_size, H).swapaxes(0, 1)
+    labels_c = labels.reshape(B, n_chunks, chunk_size).swapaxes(0, 1)
+
+    emb = embedding.astype(compute_dtype)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h, y = xs
+        logits = jnp.dot(h.astype(compute_dtype), emb.T)
+        # same logical sharding as compute_logits' full-logits path: keeps the CE
+        # vocab-parallel ("act_vocab" -> tp) instead of all-gathering the table per chunk
+        logits = nn.with_logical_constraint(logits, ("act_batch", "act_seq", "act_vocab"))
+        if logit_scale is not None:
+            logits = logits * logit_scale
+        loss_sum, num = cross_entropy_loss(logits, y, upcast=upcast)
+        return (carry[0] + loss_sum, carry[1] + num), None
+
+    (loss_sum, num_tokens), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (hidden_c, labels_c)
+    )
     return loss_sum / jnp.maximum(num_tokens, 1.0)
 
 

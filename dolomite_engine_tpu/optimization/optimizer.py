@@ -32,6 +32,9 @@ def _adamw(lr, args):
         b2=args.get("betas", (0.9, 0.95))[1],
         eps=args.get("eps", 1e-10),
         weight_decay=args.get("weight_decay", 0.1),
+        # TPU-only knob: keep the first moment in bf16 (HBM saver; torch AdamW has no
+        # equivalent — fused torch optimizers always store fp32 states)
+        mu_dtype=args.get("mu_dtype"),
     )
 
 

@@ -1,0 +1,83 @@
+"""HF-hub checkpoint resolution: make `model_name: <hub-id>` work end-to-end.
+
+Parity: reference `dolomite_engine/utils/hf_hub.py:8-29` (`download_repo`) resolves a repo id
+to a local snapshot containing config/tokenizer/safetensors via `cached_file` +
+`get_checkpoint_shard_files`, returning the directory; every loader then treats hub ids and
+local paths uniformly. Here the same contract is met with one `huggingface_hub
+.snapshot_download` of the config/tokenizer/weights file set (the modern API the per-file
+cached_file calls wrap), so the rest of the framework only ever sees a local directory.
+"""
+
+from __future__ import annotations
+
+import os
+
+# tokenizer + config sidecar files that travel with a checkpoint (shared with
+# tools/pt_to_safetensors.py so hub snapshots and .bin conversions agree on the set)
+TOKENIZER_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "tokenizer.model",
+    "vocab.json",
+    "merges.txt",
+    "special_tokens_map.json",
+    "added_tokens.json",
+    "config.json",
+    "generation_config.json",
+)
+
+# + safetensors weights — the file set the reference downloads (pytorch .bin weights are NOT
+# fetched: conversion is safetensors-based, tools/pt_to_safetensors exists for local .bin
+# checkpoints)
+_SNAPSHOT_PATTERNS = ["*.safetensors", "*.safetensors.index.json", *TOKENIZER_FILES]
+
+
+def resolve_model_path(repo_name_or_path: str, config_only: bool = False) -> str:
+    """Return a local directory for `repo_name_or_path` (reference `download_repo` semantics).
+
+    A local directory is returned unchanged; anything else is treated as a hub repo id and
+    snapshot-downloaded (config + tokenizer + safetensors; just config.json when
+    `config_only` — callers validate model_type BEFORE pulling GBs of weights). Raises
+    ValueError when the name is neither a local dir nor a resolvable hub repo (e.g.
+    zero-egress environments)."""
+    if os.path.isdir(repo_name_or_path):
+        return repo_name_or_path
+
+    try:
+        from huggingface_hub import snapshot_download
+
+        patterns = ["config.json"] if config_only else _SNAPSHOT_PATTERNS
+        return snapshot_download(repo_name_or_path, allow_patterns=patterns)
+    except Exception as e:
+        raise ValueError(
+            f"model_name '{repo_name_or_path}' is not a local checkpoint directory and could "
+            f"not be downloaded from the HuggingFace hub ({type(e).__name__}: {e}). In "
+            "offline environments, download the repo out-of-band and pass the local path."
+        ) from e
+
+
+def download_repo(repo_name_or_path: str) -> tuple[dict | None, object | None, str | None]:
+    """Reference-shaped API (`hf_hub.py:8-29`): returns (config_dict, tokenizer, model_path),
+    each None when unavailable, never raising."""
+    try:
+        path = resolve_model_path(repo_name_or_path)
+    except ValueError:
+        return None, None, None
+
+    config = None
+    config_path = os.path.join(path, "config.json")
+    if os.path.isfile(config_path):
+        import json
+
+        with open(config_path) as f:
+            config = json.load(f)
+
+    tokenizer = None
+    try:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(path)
+    except Exception:
+        pass
+
+    return config, tokenizer, path

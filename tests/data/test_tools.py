@@ -90,3 +90,36 @@ def test_preprocess_merge_roundtrip(tmp_path):
     np.testing.assert_array_equal(merged[2], [6, 7, 8, 9, 1])
 
     _run("iterate_preprocessed_data.py", "--path-prefix", str(tmp_path / "merged"))
+
+
+def test_pt_to_safetensors(tmp_path):
+    """tools/pt_to_safetensors.py: torch .bin checkpoint -> sharded safetensors + tokenizer."""
+    import torch
+
+    sys.path.insert(0, str(REPO / "tools"))
+    from pt_to_safetensors import convert
+
+    src = tmp_path / "ckpt"
+    src.mkdir()
+    state = {
+        "transformer.wte.weight": torch.randn(8, 4),
+        "lm_head.weight": torch.randn(8, 4, dtype=torch.bfloat16),
+    }
+    torch.save(state, src / "pytorch_model.bin")
+    json.dump({"model_type": "gpt_dolomite"}, open(src / "config.json", "w"))
+
+    dest = tmp_path / "st"
+    convert(str(src), str(dest))
+
+    from dolomite_engine_tpu.utils.safetensors import SafeTensorsWeightsManager
+
+    mgr = SafeTensorsWeightsManager(str(dest))
+    assert set(mgr) == set(state)
+    np.testing.assert_array_equal(
+        mgr.get_tensor("transformer.wte.weight"), state["transformer.wte.weight"].numpy()
+    )
+    got_bf16 = mgr.get_tensor("lm_head.weight")
+    np.testing.assert_array_equal(
+        got_bf16.view(np.uint16), state["lm_head.weight"].view(torch.uint16).numpy()
+    )
+    assert (dest / "config.json").is_file()

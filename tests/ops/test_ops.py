@@ -170,3 +170,73 @@ def test_power_scheduler():
     )
     assert float(f(5)) <= float(f(10)) <= 1.0
     assert float(f(50)) <= 1.0
+
+
+def test_fused_linear_cross_entropy_matches_plain():
+    """Fused chunked LM-head loss == materialized logits path, values AND grads."""
+    import numpy as np
+
+    from dolomite_engine_tpu.ops.loss import IGNORE_INDEX, fused_linear_cross_entropy
+
+    rng = np.random.RandomState(0)
+    B, S, H, V, chunk = 2, 8, 16, 32, 4
+    hidden = jnp.asarray(rng.randn(B, S, H), jnp.float32)
+    emb = jnp.asarray(rng.randn(V, H) * 0.02, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, size=(B, S)), jnp.int32)
+    labels = labels.at[0, -1].set(IGNORE_INDEX).at[1, 0].set(IGNORE_INDEX)
+
+    def plain(h, e):
+        logits = jnp.dot(h, e.T)
+        return causal_lm_loss(logits, jnp.zeros((B, S), jnp.int32), labels=labels)
+
+    def fused(h, e):
+        return fused_linear_cross_entropy(
+            h, e, labels, chunk_size=chunk, compute_dtype=jnp.float32
+        )
+
+    lp, (ghp, gep) = jax.value_and_grad(plain, argnums=(0, 1))(hidden, emb)
+    lf, (ghf, gef) = jax.value_and_grad(fused, argnums=(0, 1))(hidden, emb)
+    np.testing.assert_allclose(lp, lf, rtol=1e-6)
+    np.testing.assert_allclose(ghp, ghf, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gep, gef, rtol=1e-5, atol=1e-6)
+
+    # non-divisible seq pads up to a chunk multiple with IGNORE labels, still exact
+    lf2 = fused_linear_cross_entropy(hidden, emb, labels, chunk_size=5, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(lp, lf2, rtol=1e-6)
+
+
+def test_fused_lm_head_loss_model_parity():
+    """GPTDolomite with fused_lm_head_loss=True gives the same loss as the logits path."""
+    import numpy as np
+
+    from dolomite_engine_tpu.models import get_model_class
+    from dolomite_engine_tpu.models.config import CommonConfig
+
+    base = dict(
+        vocab_size=64,
+        n_positions=32,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        attention_head_type="mha",
+        position_embedding_type="rope",
+        activation_function="swiglu",
+        normalization_function="rmsnorm",
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        tie_word_embeddings=True,
+    )
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 64, size=(2, 32)), jnp.int32)
+
+    losses = {}
+    for fused in (False, True):
+        config = CommonConfig(**base, fused_lm_head_loss=fused, loss_chunk_size=8)
+        cls = get_model_class(config.model_type)
+        model = cls(config=config, dtype=jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), ids, compute_loss=True)
+        out = model.apply(variables, ids, compute_loss=True)
+        losses[fused] = out.loss
+        assert (out.logits is None) == fused
+
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)

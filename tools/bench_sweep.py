@@ -1,0 +1,162 @@
+"""MFU sweep harness: run the bench train step for one (model, batch, remat) point.
+
+Usage: python tools/bench_sweep.py --n_embd 2048 --n_layer 16 --micro_bs 8 --ckpt 1 [--steps 10]
+
+Prints one JSON line per run with mfu/step_time/HBM. Used to tune bench.py toward the
+>=0.40 MFU north star (BASELINE.md); findings recorded in PROFILE.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_embd", type=int, default=1024)
+    p.add_argument("--n_layer", type=int, default=24)
+    p.add_argument("--n_head", type=int, default=0)  # 0 = n_embd // 64
+    p.add_argument("--kv_heads", type=int, default=8)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--micro_bs", type=int, default=8)
+    p.add_argument("--accum", type=int, default=1)
+    p.add_argument("--ckpt", type=int, default=0, help="checkpoint_every (0 = no remat)")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--vocab", type=int, default=50304)
+    p.add_argument("--mu_dtype", type=str, default=None, help="optax adamw mu dtype override")
+    p.add_argument("--dtype", type=str, default="bf16")
+    p.add_argument("--upcast", action="store_true", help="fp32-upcast logits for loss")
+    p.add_argument("--fused_loss", action="store_true", help="chunked LM-head loss (no full logits)")
+    p.add_argument("--loss_chunk", type=int, default=256)
+    p.add_argument("--profile", type=str, default=None, help="jax.profiler trace dir")
+    args = p.parse_args()
+
+    from dolomite_engine_tpu.enums import AttentionImplementation, LRDecaySchedule, Mode
+    from dolomite_engine_tpu.model_wrapper.pretraining import ModelWrapperForPretraining
+    from dolomite_engine_tpu.optimization import get_optimizer, get_scheduler
+    from dolomite_engine_tpu.parallel.mesh import MeshManager, named_sharding
+    from dolomite_engine_tpu.train_utils import get_model_tflops, make_train_step
+    from dolomite_engine_tpu.distributed import create_sharded_train_state
+
+    backend = jax.default_backend()
+    n_head = args.n_head or args.n_embd // 64
+    config = dict(
+        model_type="gpt_dolomite",
+        vocab_size=args.vocab,
+        n_positions=args.seq,
+        n_embd=args.n_embd,
+        n_layer=args.n_layer,
+        n_head=n_head,
+        num_key_value_heads=args.kv_heads,
+        attention_head_type="gqa",
+        position_embedding_type="rope",
+        activation_function="swiglu",
+        normalization_function="rmsnorm",
+        add_bias=False,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        tie_word_embeddings=True,
+        upcast_logits_for_loss=args.upcast,
+        fused_lm_head_loss=args.fused_loss,
+        loss_chunk_size=args.loss_chunk,
+    )
+
+    MeshManager()
+    mesh = MeshManager.get_mesh()
+
+    gc_args = {"checkpoint_every": args.ckpt} if args.ckpt else None
+    wrapper = ModelWrapperForPretraining(
+        mode=Mode.training,
+        pretrained_config=config,
+        dtype=args.dtype,
+        sequence_length=args.seq,
+        attention_implementation=(
+            AttentionImplementation.flash_attention_2
+            if backend == "tpu"
+            else AttentionImplementation.sdpa
+        ),
+        reset_attention_mask=False,
+        zero_stage=3,
+        gradient_checkpointing_args=gc_args,
+    )
+
+    sched = get_scheduler(10, 0, None, 1000, LRDecaySchedule.cosine, 0.1, base_lr=3e-4)
+    opt_kwargs = {"weight_decay": 0.1, "betas": (0.9, 0.95), "eps": 1e-10}
+    if args.mu_dtype:
+        opt_kwargs["mu_dtype"] = args.mu_dtype
+    opt = get_optimizer("TorchAdamW", opt_kwargs, sched)
+    state, _ = create_sharded_train_state(wrapper, opt, mesh, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+
+    def loss_fn(params, micro, rng):
+        return wrapper.loss(params, micro["text"], train=True)
+
+    step_fn = make_train_step(loss_fn, opt, gradient_accumulation_steps=args.accum)
+    tokens = np.random.RandomState(0).randint(
+        0, config["vocab_size"], size=(args.accum, args.micro_bs, args.seq + 1)
+    ).astype(np.int32)
+
+    with mesh:
+        jit_step = jax.jit(step_fn, donate_argnums=0)
+        batch = {"text": jax.device_put(jnp.asarray(tokens), named_sharding(None, ("dp", "fsdp")))}
+        rng = jax.random.PRNGKey(1)
+
+        t_c = time.perf_counter()
+        state, metrics = jit_step(state, batch, rng)
+        jax.block_until_ready(metrics["loss"])
+        compile_s = time.perf_counter() - t_c
+
+        if args.profile:
+            with jax.profiler.trace(args.profile):
+                state, metrics = jit_step(state, batch, rng)
+                jax.block_until_ready(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, metrics = jit_step(state, batch, jax.random.fold_in(rng, i))
+        jax.block_until_ready(metrics["loss"])
+        elapsed = time.perf_counter() - t0
+
+    step_time = elapsed / args.steps
+    tokens_per_step = args.accum * args.micro_bs * args.seq
+    n_devices = jax.device_count()
+    model_tflops = get_model_tflops(
+        wrapper.config,
+        args.accum * args.micro_bs,
+        args.seq,
+        gradient_checkpointing_method="block" if args.ckpt else None,
+        gradient_checkpointing_args=gc_args,
+    )
+    mfu = model_tflops / step_time / n_devices / _PEAK_TFLOPS.get(backend, 100.0)
+
+    mem = {}
+    try:
+        ms = jax.local_devices()[0].memory_stats()
+        if ms:
+            mem = {"hbm_gb": round(ms.get("bytes_in_use", 0) / 2**30, 2),
+                   "peak_gb": round(ms.get("peak_bytes_in_use", 0) / 2**30, 2)}
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "n_embd": args.n_embd, "n_layer": args.n_layer, "micro_bs": args.micro_bs,
+        "accum": args.accum, "ckpt": args.ckpt, "params_m": round(n_params / 1e6, 1),
+        "mfu": round(mfu, 4), "step_ms": round(step_time * 1e3, 1),
+        "tok_s": round(tokens_per_step / step_time / n_devices, 0),
+        "compile_s": round(compile_s, 1), **mem,
+    }))
+
+
+if __name__ == "__main__":
+    main()
