@@ -52,13 +52,16 @@ def generate_tokens(
     )
 
     caches = model.init_kv_caches(batch, total)
+    # cache_index as a STATIC 0 (python int, not a traced zero): the attention layer keys its
+    # prefill fast path (local-k/v flash attend, modeling_utils.py) on a statically-known
+    # whole-prompt write at index 0
     prefill = model.apply(
         {"params": params} if "params" not in params else params,
         input_ids,
         position_ids=position_ids,
         attention_mask=full_mask,
         kv_caches=caches,
-        cache_index=jnp.zeros((), jnp.int32),
+        cache_index=0,
     )
 
     rng, step_rng = jax.random.split(rng)

@@ -246,6 +246,23 @@ def attention(
             )
         implementation = AttentionImplementation.sdpa
 
+    if (
+        implementation == AttentionImplementation.flash_attention_2
+        and attention_mask is not None
+        and attention_mask.ndim == 2  # key-side [B, S] padding mask
+        and segment_ids is None
+        and causal
+        and q.shape[1] == k.shape[1]
+        and isinstance(query_offset, int)
+        and query_offset == 0
+    ):
+        # key-side padding mask -> segment ids (pad = 0, real = 1): the padding-free packed
+        # representation the flash kernel already understands, so left-padded batches
+        # (finetuning, generation prefill) ride the Pallas kernel instead of masked sdpa.
+        # Pad queries attend only among themselves (segment 0); their outputs are never read.
+        segment_ids = attention_mask.astype(jnp.int32)
+        attention_mask = None
+
     use_flash = (
         implementation == AttentionImplementation.flash_attention_2
         and jax.default_backend() == "tpu"

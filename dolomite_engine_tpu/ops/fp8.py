@@ -46,3 +46,48 @@ def make_fp8_dot(name: str = "fp8_dot"):
     from flax.linen import fp8_ops
 
     return fp8_ops.Fp8DirectDotGeneralOp(name=name)
+
+
+class Fp8QDQ:
+    """e4m3 quantize-dequantize of ONE tensor with delayed scaling, as a flax-variable holder.
+
+    For dots that cannot ride flax's Fp8DotGeneral (`lax.ragged_dot` grouped GEMMs, the
+    chunked LM-head loss scan): qdq the operands to fp8 numerics up front and run the GEMM in
+    the compute dtype. Forward numerics match the direct-fp8 path (same
+    quantize_dequantize_update); the wgrad/dgrad quantization is skipped — v5e has no native
+    fp8 MXU anyway, so fp8 here is numerics + forward-compat, not a FLOP saver (the
+    reference's TE fp8 is likewise a numerics-affecting drop-in, `distributed/fp8/nv_te.py`).
+
+    Usage (inside a flax module): ``Fp8QDQ(module, "lm_head_in")(x)``. State rides the
+    OWG collection like every other fp8 scale.
+    """
+
+    def __init__(self, module, name: str, amax_history_length: int = 1024):
+        import jax
+        import jax.numpy as jnp
+        from flax.linen import initializers
+
+        self._scale = module.variable(
+            OWG_COLLECTION,
+            f"{name}_scale",
+            initializers.ones_init(),
+            jax.random.PRNGKey(0),
+            (1,),
+            jnp.float32,
+        )
+        self._amax_history = module.variable(
+            OWG_COLLECTION,
+            f"{name}_amax_history",
+            initializers.zeros_init(),
+            jax.random.PRNGKey(0),
+            (amax_history_length,),
+            jnp.float32,
+        )
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from flax.linen import fp8_ops
+
+        return fp8_ops.in_qdq(
+            x.dtype, jnp.float8_e4m3fn, x, self._scale.value, self._amax_history.value
+        )

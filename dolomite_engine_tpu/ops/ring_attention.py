@@ -119,7 +119,10 @@ def ring_attention_sharded(
     softmax_scale: float | None = None,
     segment_ids: jax.Array | None = None,
     seq_axis: str = "sp",
-    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    # "ep" included: on an ep>1 mesh activations are batch-sharded over (dp, fsdp, ep)
+    # (parallel/sharding.py act_batch rule) — omitting it would silently all-gather the batch
+    # over "ep" at every attention call when sp>1 and ep>1 compose
+    batch_axes: tuple[str, ...] = ("dp", "fsdp", "ep"),
     head_axis: str = "tp",
 ) -> jax.Array:
     """GSPMD-callable wrapper: shard_map `ring_attention` with batch over `batch_axes`,

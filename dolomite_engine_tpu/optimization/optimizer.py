@@ -25,6 +25,17 @@ from ..enums import ParamsGroupMethod
 # kernel-variant aliases collapse to their mathematical equivalent
 
 
+def _resolve_mu_dtype(value):
+    if value is None or not isinstance(value, str):
+        return value
+    from ..utils.mixed_precision import string_to_dtype
+
+    try:
+        return string_to_dtype(value)
+    except Exception:
+        return value  # numpy-style names ("bfloat16") pass through to optax
+
+
 def _adamw(lr, args):
     return optax.adamw(
         lr,
@@ -33,8 +44,9 @@ def _adamw(lr, args):
         eps=args.get("eps", 1e-10),
         weight_decay=args.get("weight_decay", 0.1),
         # TPU-only knob: keep the first moment in bf16 (HBM saver; torch AdamW has no
-        # equivalent — fused torch optimizers always store fp32 states)
-        mu_dtype=args.get("mu_dtype"),
+        # equivalent — fused torch optimizers always store fp32 states). Accepts the repo's
+        # dtype names ("bf16") as well as numpy-style ones.
+        mu_dtype=_resolve_mu_dtype(args.get("mu_dtype")),
     )
 
 

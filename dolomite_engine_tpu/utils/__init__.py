@@ -42,15 +42,28 @@ def init_distributed(timeout_minutes: int | None = None) -> None:
     if _DISTRIBUTED_INITIALIZED:
         return
 
-    # heuristics: only initialize when launched as one process of a multi-process job
+    # heuristics: initialize when launched as one process of a multi-process job — explicit
+    # coordinator env (manual launch), or a TPU pod slice (metadata server populates
+    # TPU_WORKER_HOSTNAMES with every host of the slice; jax's cluster auto-detection then
+    # supplies coordinator/process_id without further config)
+    tpu_workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     multiprocess_env = any(
         os.environ.get(k) is not None
         for k in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
-    )
+    ) or len([h for h in tpu_workers.split(",") if h.strip()]) > 1
     if multiprocess_env:
         kwargs = {}
         if timeout_minutes is not None:
             kwargs["initialization_timeout"] = timeout_minutes * 60
+        # manual rendezvous (off-GCP pods, scripts/pretrain_pod.sh): JAX's cluster
+        # auto-detection covers TPU metadata/SLURM/OMPI; plain-env launches pass these
+        coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if coordinator is not None:
+            kwargs["coordinator_address"] = coordinator
+            if os.environ.get("JAX_PROCESS_COUNT") is not None:
+                kwargs["num_processes"] = int(os.environ["JAX_PROCESS_COUNT"])
+            if os.environ.get("JAX_PROCESS_INDEX") is not None:
+                kwargs["process_id"] = int(os.environ["JAX_PROCESS_INDEX"])
         jax.distributed.initialize(**kwargs)
 
     _DISTRIBUTED_INITIALIZED = True

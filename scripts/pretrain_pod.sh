@@ -1,0 +1,37 @@
+#!/bin/bash
+# Multi-host TPU pod launcher (parity: reference `scripts/pretrain.sh`, which derives
+# node rank / master address from the LSF env and torchruns dolomite_engine.pretrain).
+#
+# On a TPU pod slice (e.g. v5e-256 = 64 hosts x 4 chips), run THIS SAME SCRIPT on every
+# host (gcloud alpha compute tpus tpu-vm ssh $TPU_NAME --worker=all --command="..."):
+# jax.distributed.initialize() discovers the coordinator and the host's process index from
+# the TPU metadata server automatically — no torchrun/rendezvous flags needed.
+#
+#   ./scripts/pretrain_pod.sh configs/pretrain.yml
+#
+# Off-GCP / manual rendezvous (e.g. bare-metal pods, CPU smoke tests): set
+#   JAX_COORDINATOR_ADDRESS=<host0-ip>:<port>   # same on every host
+#   JAX_PROCESS_COUNT=<num_hosts>               # total host count
+#   JAX_PROCESS_INDEX=<this-host-rank>          # 0..num_hosts-1
+# dolomite_engine_tpu.utils.init_distributed() forwards them to
+# jax.distributed.initialize() (utils/__init__.py:33-58).
+#
+# Data: each host reads only its 1/num_hosts share of the global batch
+# (data/megatron/__init__.py MegatronBatchSampler(num_replicas=num_hosts, rank=host_rank));
+# ShardedDataLoader assembles the global array with
+# jax.make_array_from_process_local_data — no cross-host data traffic. Host 0 builds the
+# megatron index caches first; other hosts wait on a barrier, then mmap the same caches
+# (requires data_cache_path on a shared filesystem, same as the reference's Megatron
+# pipeline).
+#
+# Checkpoints: orbax writes per-host shards of the sharded TrainState; rng/dataloader
+# state is saved per process (checkpointing.py) — resume with the same host count.
+
+set -euo pipefail
+
+CONFIG=${1:?"usage: pretrain_pod.sh <config.yml>"}
+
+export TOKENIZERS_PARALLELISM=false
+# one python process per host drives all local chips; jax.distributed.initialize() is
+# called inside (guarded by the env heuristics in utils.init_distributed)
+exec python -m dolomite_engine_tpu.pretrain --config "$CONFIG"
