@@ -30,7 +30,11 @@ def main() -> None:
     from dolomite_engine_tpu.distributed import create_sharded_train_state
 
     if on_tpu:
-        seq, micro_bs, accum = 2048, 8, 1
+        # PROFILE.md: ~25% of a single-dispatch step is tunnel/dispatch latency — accum=8
+        # folds 8 micro-steps into one jitted call (lax.scan) and amortizes it; the fused
+        # chunked LM-head loss removes the [B,S,V] logits allocation (largest in the step).
+        # Measured 0.342 -> 0.397 MFU on the r2 model (tools/bench_sweep.py sweep).
+        seq, micro_bs, accum = 2048, 8, 8
         config = dict(
             model_type="gpt_dolomite",
             vocab_size=50304,
@@ -48,9 +52,10 @@ def main() -> None:
             embd_pdrop=0.0,
             attn_pdrop=0.0,
             tie_word_embeddings=True,
+            fused_lm_head_loss=True,
         )
         dtype = "bf16"
-        steps = 20
+        steps = 8
     else:
         seq, micro_bs, accum = 256, 2, 1
         config = dict(

@@ -131,9 +131,11 @@ def fused_linear_cross_entropy(
     def body(carry, xs):
         h, y = xs
         logits = jnp.dot(h.astype(compute_dtype), emb.T)
-        # same logical sharding as compute_logits' full-logits path: keeps the CE
-        # vocab-parallel ("act_vocab" -> tp) instead of all-gathering the table per chunk
-        logits = nn.with_logical_constraint(logits, ("act_batch", "act_seq", "act_vocab"))
+        # keep the CE vocab-parallel ("act_vocab" -> tp) instead of all-gathering the table
+        # per chunk. The chunk-local seq axis stays UNSHARDED (None, not "act_seq"): the
+        # S -> (n_chunks, chunk) reshape already broke any sp sharding, and re-claiming
+        # "act_seq" here forces an SPMD reshard of every chunk on sp>1 meshes.
+        logits = nn.with_logical_constraint(logits, ("act_batch", None, "act_vocab"))
         if logit_scale is not None:
             logits = logits * logit_scale
         loss_sum, num = cross_entropy_loss(logits, y, upcast=upcast)

@@ -164,3 +164,49 @@ def test_fp8_covers_moe_experts(mesh_fsdp8):
             losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], f"fp8 MoE loss did not decrease: {losses}"
+
+
+def test_fp8_state_checkpoint_roundtrip(mesh_fsdp8, tmp_path):
+    """fp8 delayed-scaling state (incl. the new expert/LM-head qdq entries) survives
+    save -> restore exactly (checkpointing.py:231-277 fp8-aware restore)."""
+    from dolomite_engine_tpu.arguments import TrainingArgs
+    from dolomite_engine_tpu.checkpointing import (
+        load_checkpoint_for_training,
+        save_checkpoint,
+    )
+
+    _, state, wrapper = _run_steps("fp8", mesh_fsdp8, steps=2)
+
+    args = TrainingArgs(
+        model_args=dict(model_class="AutoModelForCausalLM", pretrained_config=_config()),
+        tuning_args=dict(tuning_method="pretraining"),
+        training_parameters=dict(
+            num_training_steps=4, micro_batch_size=8, eval_during_training=False
+        ),
+        datasets=[
+            dict(
+                class_name="DebugDataset",
+                data_name="debug",
+                class_args=dict(num_examples=8),
+            )
+        ],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=1),
+        load_args=dict(load_path=str(tmp_path / "ckpt")),
+        random_args=dict(seed=3),
+    )
+    save_checkpoint(args, wrapper, state, None, None, iteration=2, jax_rng=jax.random.PRNGKey(0))
+
+    # fresh state (different rng -> different fp8 history), then restore
+    from dolomite_engine_tpu.optimization import get_optimizer, get_scheduler
+
+    wrapper2 = _wrapper("fp8")
+    opt = _optimizer()
+    fresh, _ = create_sharded_train_state(wrapper2, opt, mesh_fsdp8, jax.random.PRNGKey(9))
+    restored, it, _, _ = load_checkpoint_for_training(args, fresh)
+
+    assert it == 2
+    want = jax.tree.leaves(state.fp8)
+    got = jax.tree.leaves(restored.fp8)
+    assert len(want) == len(got) and len(got) > 0
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))

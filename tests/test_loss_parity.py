@@ -26,6 +26,9 @@ def test_live_loss_parity_short(tmp_path):
         text=True,
         timeout=1200,
         cwd=REPO,
+        # force the CPU env regardless of how pytest itself runs (DOLOMITE_TPU_TESTS_ON_TPU=1
+        # would otherwise let the child claim the parent's single tunneled chip and hang)
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
     result = json.load(open(out))
