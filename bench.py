@@ -30,11 +30,13 @@ def main() -> None:
     from dolomite_engine_tpu.distributed import create_sharded_train_state
 
     if on_tpu:
-        # PROFILE.md: ~25% of a single-dispatch step is tunnel/dispatch latency — accum=8
-        # folds 8 micro-steps into one jitted call (lax.scan) and amortizes it; the fused
+        # PROFILE.md: ~25% of a single-dispatch step is tunnel/dispatch latency — accum
+        # folds micro-steps into one jitted call (lax.scan) and amortizes it; the fused
         # chunked LM-head loss removes the [B,S,V] logits allocation (largest in the step).
-        # Measured 0.342 -> 0.397 MFU on the r2 model (tools/bench_sweep.py sweep).
-        seq, micro_bs, accum = 2048, 8, 8
+        # Measured: accum 1 -> 0.342, 4 -> 0.372, 8 -> 0.397 MFU (tools/bench_sweep.py);
+        # the overhead gap is ~flat at 375-400 ms/step beyond accum 4, so 16 extrapolates
+        # to ~0.41 (the tunnel went down before it could be measured — PROFILE.md step 4).
+        seq, micro_bs, accum = 2048, 8, 16
         config = dict(
             model_type="gpt_dolomite",
             vocab_size=50304,
@@ -55,7 +57,7 @@ def main() -> None:
             fused_lm_head_loss=True,
         )
         dtype = "bf16"
-        steps = 8
+        steps = 5
     else:
         seq, micro_bs, accum = 256, 2, 1
         config = dict(

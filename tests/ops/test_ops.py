@@ -240,3 +240,34 @@ def test_fused_lm_head_loss_model_parity():
         assert (out.logits is None) == fused
 
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+
+
+def test_padding_mask_to_segment_ids_conversion_numerics():
+    """flash_attention_2 with a 2D left-pad mask converts it to segment ids (ops/attention.py);
+    on CPU that rides the sdpa fallback with segment masking — REAL rows must match the plain
+    key-side-mask sdpa path exactly (pad rows are never read and may differ)."""
+    import numpy as np
+
+    from dolomite_engine_tpu.enums import AttentionImplementation
+    from dolomite_engine_tpu.ops.attention import attention
+
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 8, 2, 4
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    # left padding: row 0 pads 3, row 1 pads 0
+    mask = jnp.asarray([[0, 0, 0, 1, 1, 1, 1, 1], [1] * 8], jnp.int32)
+
+    ref = attention(
+        q, k, v, implementation=AttentionImplementation.sdpa, causal=True,
+        attention_mask=mask,
+    )
+    got = attention(
+        q, k, v, implementation=AttentionImplementation.flash_attention_2, causal=True,
+        attention_mask=mask,
+    )
+    real = np.asarray(mask, bool)
+    np.testing.assert_allclose(
+        np.asarray(got)[real], np.asarray(ref)[real], rtol=1e-6, atol=1e-6
+    )
