@@ -242,41 +242,36 @@ class GPTDolomiteForCausalLM(nn.Module):
             and kv_caches is None
         )
 
+        logits = None
+        loss = None
+        aux_loss = None
         if use_fused:
             # chunked LM-head matmul + CE; never materializes [B, S, V] logits (ops/loss.py)
-            fl_labels = (
-                labels
-                if labels is not None
-                else derive_causal_labels(input_ids, attention_mask, segment_ids)
-            )
-            head_in, head_table = self._fp8_head_operands(hidden_states)
+            if labels is None:
+                labels = derive_causal_labels(input_ids, attention_mask, segment_ids)
+            head_in, head_table = self._lm_head_operands(hidden_states)
             loss = fused_linear_cross_entropy(
                 head_in,
                 head_table,
-                fl_labels,
+                labels,
                 chunk_size=self.config.loss_chunk_size,
                 upcast=self.config.upcast_logits_for_loss,
                 logit_scale=None if self.config.m_width is None else 1.0 / self.config.m_width,
                 compute_dtype=self.dtype,
             )
-            aux_loss = self.compute_aux_loss(extras, attention_mask, segment_ids)
-            if aux_loss is not None:
-                loss = loss + aux_loss
-            return CausalLMOutput(logits=None, loss=loss, kv_caches=new_caches, aux_loss=aux_loss)
+        else:
+            logits = self.compute_logits(hidden_states)
+            if want_loss:
+                loss = causal_lm_loss(
+                    logits,
+                    input_ids,
+                    upcast=self.config.upcast_logits_for_loss,
+                    attention_mask=attention_mask,
+                    segment_ids=segment_ids,
+                    labels=labels,
+                )
 
-        logits = self.compute_logits(hidden_states)
-
-        loss = None
-        aux_loss = None
         if want_loss:
-            loss = causal_lm_loss(
-                logits,
-                input_ids,
-                upcast=self.config.upcast_logits_for_loss,
-                attention_mask=attention_mask,
-                segment_ids=segment_ids,
-                labels=labels,
-            )
             aux_loss = self.compute_aux_loss(extras, attention_mask, segment_ids)
             if aux_loss is not None:
                 loss = loss + aux_loss
@@ -292,25 +287,21 @@ class GPTDolomiteForCausalLM(nn.Module):
         """Hook for MoE subclasses: auxiliary loss from per-block extras (router logits)."""
         return None
 
-    def _fp8_head_operands(self, hidden_states: jax.Array) -> tuple[jax.Array, jax.Array]:
-        """(hidden, embedding_table) for the tied head, e4m3-qdq'd when fp8 is on."""
+    def _lm_head_operands(self, hidden_states: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """(hidden, embedding_table) for the tied head in compute dtype, e4m3-qdq'd when fp8
+        is on (shared by compute_logits and the fused chunked loss)."""
         table = self.transformer.wte.embedding_table()
+        hidden_states = hidden_states.astype(self.dtype)
+        table = table.astype(self.dtype)
         fp8_in = getattr(self, "_fp8_head_in", None)
         if fp8_in is not None:
-            return (
-                fp8_in(hidden_states.astype(self.dtype)),
-                self._fp8_head_kernel(table.astype(self.dtype)),
-            )
+            return fp8_in(hidden_states), self._fp8_head_kernel(table)
         return hidden_states, table
 
     def compute_logits(self, hidden_states: jax.Array) -> jax.Array:
         if self.config.tie_word_embeddings:
-            fp8_in = getattr(self, "_fp8_head_in", None)
-            if fp8_in is not None:
-                head_in, head_table = self._fp8_head_operands(hidden_states)
-                logits = jnp.dot(head_in, head_table.astype(self.dtype).T)
-            else:
-                logits = self.transformer.wte.attend(hidden_states)
+            head_in, head_table = self._lm_head_operands(hidden_states)
+            logits = jnp.dot(head_in, head_table.T)
         else:
             logits = self.lm_head(hidden_states)
         logits = nn.with_logical_constraint(logits, ("act_batch", "act_seq", "act_vocab"))

@@ -18,6 +18,9 @@ from __future__ import annotations
 from contextlib import contextmanager
 from contextvars import ContextVar
 
+import jax
+import jax.numpy as jnp
+
 OWG_COLLECTION = "_overwrite_with_gradient"
 
 # ContextVar, not a module global: traces from different wrappers (or threads — pjit traces
@@ -65,8 +68,6 @@ class Fp8QDQ:
     """
 
     def __init__(self, module, name: str, amax_history_length: int = 1024):
-        import jax
-        import jax.numpy as jnp
         from flax.linen import initializers
 
         self._scale = module.variable(
@@ -87,7 +88,6 @@ class Fp8QDQ:
         )
 
     def __call__(self, x):
-        import jax.numpy as jnp
         from flax.linen import fp8_ops
 
         return fp8_ops.in_qdq(
