@@ -25,8 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-CONFIG = dict(
-    model_type="gpt_dolomite",
+_BASE_CONFIG = dict(
     vocab_size=512,
     n_positions=64,
     n_embd=128,
@@ -46,6 +45,22 @@ CONFIG = dict(
     pad_token_id=2,
     tie_word_embeddings=True,
 )
+
+_FAMILY_CONFIGS = {
+    "gpt_dolomite": dict(_BASE_CONFIG, model_type="gpt_dolomite"),
+    # aux loss rides the model-internal labels path on BOTH sides (the reference's external
+    # pretraining CE never adds aux loss — hf_models/models/moe_dolomite/main.py:112-118 only
+    # does with labels + output_router_logits)
+    "moe_dolomite": dict(
+        _BASE_CONFIG,
+        model_type="moe_dolomite",
+        num_experts=4,
+        num_experts_per_tok=2,
+        router_aux_loss_coef=0.01,
+    ),
+}
+
+CONFIG = _FAMILY_CONFIGS["gpt_dolomite"]
 SEQ = 64
 MICRO_BS = 8
 LR = 3e-4
@@ -158,12 +173,27 @@ def run_reference_engine(steps: int, batches: np.ndarray, ckpt_dir: str) -> list
 
     import torch
     import torch.nn.functional as F
-    from dolomite_engine.hf_models import GPTDolomiteForCausalLM
 
+    is_moe = CONFIG["model_type"] == "moe_dolomite"
     torch.manual_seed(1234)
-    model = GPTDolomiteForCausalLM.from_pretrained(
-        ckpt_dir, attn_implementation="sdpa", torch_dtype=torch.float32
-    )
+    if is_moe:
+        from dolomite_engine.hf_models.models.moe_dolomite import MoEDolomiteForCausalLM
+
+        model = MoEDolomiteForCausalLM.from_pretrained(
+            ckpt_dir,
+            attn_implementation="sdpa",
+            torch_dtype=torch.float32,
+            moe_implementation="eager",
+        )
+        # the exact aux-loss function the reference model applies
+        # (hf_models/models/moe_dolomite/base.py:5,38-41)
+        from transformers.models.mixtral.modeling_mixtral import load_balancing_loss_func
+    else:
+        from dolomite_engine.hf_models import GPTDolomiteForCausalLM
+
+        model = GPTDolomiteForCausalLM.from_pretrained(
+            ckpt_dir, attn_implementation="sdpa", torch_dtype=torch.float32
+        )
     model.train()
     optimizer = torch.optim.AdamW(
         model.parameters(),
@@ -178,8 +208,17 @@ def run_reference_engine(steps: int, batches: np.ndarray, ckpt_dir: str) -> list
         tokens = torch.from_numpy(batches[t])
         input_ids = tokens[:, :-1]
         labels = tokens[:, 1:]
-        logits = model(input_ids=input_ids).logits.float()
+        if is_moe:
+            out = model(input_ids=input_ids, output_router_logits=True)
+            logits = out.logits.float()
+        else:
+            logits = model(input_ids=input_ids).logits.float()
         loss = F.cross_entropy(logits.view(-1, logits.size(-1)), labels.reshape(-1))
+        if is_moe:
+            aux = load_balancing_loss_func(
+                out.router_logits, CONFIG["num_experts"], CONFIG["num_experts_per_tok"]
+            )
+            loss = loss + CONFIG["router_aux_loss_coef"] * aux
         optimizer.zero_grad()
         loss.backward()
         torch.nn.utils.clip_grad_norm_(model.parameters(), CLIP)
@@ -189,10 +228,20 @@ def run_reference_engine(steps: int, batches: np.ndarray, ckpt_dir: str) -> list
 
 
 def main() -> None:
+    global CONFIG
+
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=200)
-    p.add_argument("--out", type=str, default=os.path.join(os.path.dirname(__file__), "..", "LOSS_PARITY.json"))
+    p.add_argument("--family", choices=sorted(_FAMILY_CONFIGS), default="gpt_dolomite")
+    p.add_argument("--out", type=str, default=None)
     args = p.parse_args()
+
+    CONFIG = _FAMILY_CONFIGS[args.family]
+    if args.out is None:
+        suffix = "" if args.family == "gpt_dolomite" else f"_{args.family}"
+        args.out = os.path.join(
+            os.path.dirname(__file__), "..", f"LOSS_PARITY{suffix}.json"
+        )
 
     with tempfile.TemporaryDirectory() as workdir:
         batches = build_batches(args.steps, workdir)
