@@ -334,7 +334,8 @@ class DistributedArgs(BaseArgs):
     overlap_comm: bool = False
     # accepted no-op (GPU memory layout knob)
     contiguous_gradients: bool = False
-    # CPU offloading (accepted; maps to host-offloaded optimizer state when enabled)
+    # CPU offloading: optimizer state lives in pinned host memory (ZeRO-Offload
+    # equivalent; distributed/__init__.py get_state_shardings)
     cpu_offload: bool = False
     # gradient checkpointing method
     gradient_checkpointing_method: GradientCheckpointingMethod | None = None

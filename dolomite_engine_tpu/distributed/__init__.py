@@ -52,11 +52,15 @@ def get_state_shardings(
     model: ModelWrapper,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
+    offload_optimizer: bool = False,
 ) -> tuple[Any, Any]:
     """(abstract_state, sharding tree) for the full TrainState.
 
     Params follow the param rules; optimizer state follows the optimizer rules (ZeRO-1/2 shard
-    opt state while params stay replicated); scalars replicate.
+    opt state while params stay replicated); scalars replicate. `offload_optimizer` places the
+    optimizer-state arrays in `pinned_host` memory (DeepSpeed `cpu_offload` equivalent,
+    reference `arguments.py:338` / ZeRO-Offload): XLA streams them to HBM around the update —
+    +~8 bytes/param of HBM freed for the model, at the cost of host<->device traffic per step.
     """
     import jax.numpy as jnp
 
@@ -83,6 +87,14 @@ def get_state_shardings(
     opt_shardings = logical_to_mesh_sharding(
         logical_specs.opt_state, mesh, model.sharding_rules(for_optimizer=True)
     )
+    if offload_optimizer:
+        # same layout, host memory space; jax transfers to HBM lazily at use
+        opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
+            if isinstance(s, NamedSharding)
+            else s,
+            opt_shardings,
+        )
     replicated = NamedSharding(mesh, PartitionSpec())
     shardings = TrainState(
         step=replicated,
@@ -100,13 +112,14 @@ def create_sharded_train_state(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     rng: jax.Array,
+    offload_optimizer: bool = False,
 ) -> tuple[TrainState, Any]:
     """Initialize the TrainState sharded-from-birth; returns (state, shardings)."""
     import jax.numpy as jnp
 
     from ..ops.fp8 import OWG_COLLECTION
 
-    _, shardings = get_state_shardings(model, optimizer, mesh)
+    _, shardings = get_state_shardings(model, optimizer, mesh, offload_optimizer)
 
     def _init():
         variables = model.model.init(rng, **model.get_dummy_inputs())
@@ -119,8 +132,22 @@ def create_sharded_train_state(
             fp8=nn.unbox(variables.get(OWG_COLLECTION)),
         )
 
+    # init on device (XLA's partitioner rejects mixed memory kinds in out_shardings of one
+    # program), then move the optimizer state to pinned host in a single device_put
+    device_shardings = shardings
+    if offload_optimizer:
+        device_shardings = shardings.replace(
+            opt_state=jax.tree.map(
+                lambda s: NamedSharding(s.mesh, s.spec, memory_kind="device")
+                if isinstance(s, NamedSharding)
+                else s,
+                shardings.opt_state,
+            )
+        )
     with mesh, model.fp8_scope():
-        state = jax.jit(_init, out_shardings=shardings)()
+        state = jax.jit(_init, out_shardings=device_shardings)()
+    if offload_optimizer:
+        state = state.replace(opt_state=jax.device_put(state.opt_state, shardings.opt_state))
     return state, shardings
 
 
@@ -129,5 +156,15 @@ def wrap_model_for_distributed_training(args, model: ModelWrapper, optimizer, rn
     mesh = build_mesh_from_args(args)
     if rng is None:
         rng = jax.random.PRNGKey(args.random_args.seed)
-    state, shardings = create_sharded_train_state(model, optimizer, mesh, rng)
+    from ..train_utils import resolve_cpu_offload
+
+    state, shardings = create_sharded_train_state(
+        model,
+        optimizer,
+        mesh,
+        rng,
+        # DeepSpeed cpu_offload equivalent: optimizer state lives in pinned host memory
+        # (same backend gate the training loops use — warn-and-ignore off TPU)
+        offload_optimizer=resolve_cpu_offload(args),
+    )
     return mesh, state, shardings

@@ -34,6 +34,8 @@ from .train_utils import (
     get_profiler_context,
     make_eval_step,
     make_train_step,
+    offload_jit_kwargs as _offload_jit_kwargs,
+    resolve_cpu_offload as _resolve_cpu_offload,
     track_train_metrics,
 )
 from .utils import (
@@ -103,14 +105,18 @@ def train(
         rngs = None if rng is None else {"dropout": rng, "neft": rng}
         return model.loss(params, micro_batch, rngs=rngs, train=True, fp8_state=fp8_state)
 
+    offload = _resolve_cpu_offload(args)
+    jit_kwargs = _offload_jit_kwargs(state) if offload else {}
     train_step = jax.jit(
         make_train_step(
             loss_fn,
             optimizer,
             gradient_accumulation_steps=gradient_accumulation_steps,
             gradient_clipping=args.training_parameters.gradient_clipping,
+            offload_optimizer=offload,
         ),
         donate_argnums=(0,),
+        **jit_kwargs,
     )
     eval_step = jax.jit(
         make_eval_step(
@@ -260,7 +266,10 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
     optimizer, lr_schedule = build_optimizer_from_args(args, model)
 
     rng = jax.random.PRNGKey(args.random_args.seed)
-    state, _ = create_sharded_train_state(model, optimizer, mesh, rng)
+    offload = _resolve_cpu_offload(args)
+    state, _ = create_sharded_train_state(
+        model, optimizer, mesh, rng, offload_optimizer=offload
+    )
 
     starting_iteration = 0
     metadata = None

@@ -118,7 +118,11 @@ def prune_indivisible_shardings(abstract_tree, sharding_tree, mesh: Mesh):
     """Apply `prune_indivisible_spec` leaf-wise over (ShapeDtypeStruct tree, NamedSharding tree)."""
     return jax.tree.map(
         lambda leaf, sh: (
-            NamedSharding(mesh, prune_indivisible_spec(sh.spec, leaf.shape, mesh))
+            NamedSharding(
+                mesh,
+                prune_indivisible_spec(sh.spec, leaf.shape, mesh),
+                memory_kind=sh.memory_kind,  # preserve host offload placement
+            )
             if isinstance(sh, NamedSharding)
             else sh
         ),

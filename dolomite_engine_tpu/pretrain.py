@@ -39,6 +39,8 @@ from .train_utils import (
     get_profiler_context,
     make_eval_step,
     make_train_step,
+    offload_jit_kwargs as _offload_jit_kwargs,
+    resolve_cpu_offload as _resolve_cpu_offload,
     track_train_metrics,
 )
 from .utils import (
@@ -147,6 +149,8 @@ def train(
         rngs = None if rng is None else {"dropout": rng}
         return model.loss(params, text, rngs=rngs, train=True, fp8_state=fp8_state)
 
+    offload = _resolve_cpu_offload(args)
+    jit_kwargs = _offload_jit_kwargs(state) if offload else {}
     train_step = jax.jit(
         make_train_step(
             lambda params, micro, rng, fp8_state=None: loss_fn(
@@ -155,8 +159,10 @@ def train(
             optimizer,
             gradient_accumulation_steps=gradient_accumulation_steps,
             gradient_clipping=args.training_parameters.gradient_clipping,
+            offload_optimizer=offload,
         ),
         donate_argnums=(0,),
+        **jit_kwargs,
     )
     eval_step_fn = jax.jit(
         make_eval_step(
@@ -287,7 +293,10 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
     optimizer, lr_schedule = build_optimizer_from_args(args, model)
 
     rng = jax.random.PRNGKey(args.random_args.seed)
-    state, _ = create_sharded_train_state(model, optimizer, mesh, rng)
+    offload = _resolve_cpu_offload(args)
+    state, _ = create_sharded_train_state(
+        model, optimizer, mesh, rng, offload_optimizer=offload
+    )
 
     starting_iteration = 0
     consumed_samples = 0

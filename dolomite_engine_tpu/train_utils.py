@@ -45,20 +45,57 @@ def clip_grad_norm(grads, max_norm: float | None):
     return jax.tree.map(lambda g: g * scale, grads), grad_norm
 
 
+_CPU_OFFLOAD_WARNED = False
+
+
+def resolve_cpu_offload(args) -> bool:
+    """cpu_offload needs in-jit memory-space transfers — TPU only; warn-and-ignore elsewhere
+    (the reference's DeepSpeed cpu_offload is likewise backend-conditional)."""
+    if not args.distributed_args.cpu_offload:
+        return False
+    if jax.default_backend() != "tpu":
+        global _CPU_OFFLOAD_WARNED
+        if not _CPU_OFFLOAD_WARNED:
+            _CPU_OFFLOAD_WARNED = True
+            log_rank_0(
+                logging.WARNING,
+                f"cpu_offload ignored on backend '{jax.default_backend()}' (pinned-host "
+                "optimizer streaming requires TPU)",
+            )
+        return False
+    return True
+
+
+def offload_jit_kwargs(state) -> dict:
+    """Extra `jax.jit` kwargs for an offloaded train step: pin the output TrainState to the
+    live state's shardings (opt state -> pinned_host) so the update streams back to host.
+    Shared by pretrain/finetune (and the offload tests)."""
+    return {"out_shardings": (jax.tree.map(lambda x: x.sharding, state), None)}
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
     gradient_accumulation_steps: int = 1,
     gradient_clipping: float | None = 1.0,
     rng_per_step: bool = True,
+    offload_optimizer: bool = False,
 ):
     """Build the jitted train step.
 
     `loss_fn(params, micro_batch, rng) -> scalar loss`. `batch` passed to the returned step has
     a leading [gradient_accumulation_steps] axis on every leaf.
+
+    `offload_optimizer` (cpu_offload, TPU only): the incoming opt state lives in pinned host
+    memory — stream it to device for the update; the caller's jit `out_shardings` (the state
+    shardings from `create_sharded_train_state`) pin the new opt state back to host.
     """
 
     def train_step(state: TrainState, batch, rng: jax.Array):
+        if offload_optimizer:
+            state = state.replace(
+                opt_state=jax.device_put(state.opt_state, jax.memory.Space.Device)
+            )
         use_fp8 = state.fp8 is not None
 
         def micro_loss(params, fp8_state, micro_batch, micro_rng):
