@@ -6,7 +6,7 @@ import os
 
 import pytest
 
-from dolomite_engine_tpu.arguments import TrainingArgs, UnshardingArgs
+from dolomite_engine_tpu.arguments import InferenceArgs, TrainingArgs, UnshardingArgs
 from dolomite_engine_tpu.utils import load_yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -16,9 +16,13 @@ CONFIGS = sorted(glob.glob(os.path.join(REPO, "configs", "**", "*.yml"), recursi
 @pytest.mark.parametrize("path", CONFIGS, ids=[os.path.basename(p) for p in CONFIGS])
 def test_config_parses(path):
     raw = load_yaml(path)
-    if "unshard" in os.path.basename(path):
+    name = os.path.basename(path)
+    if "unshard" in name:
         args = UnshardingArgs(**raw)
         assert args.unsharded_path
+    elif "generation" in name:
+        args = InferenceArgs(**raw)
+        assert args.generation_parameters.max_new_tokens
     else:
         args = TrainingArgs(**raw)
         assert args.model_args is not None
