@@ -99,8 +99,8 @@ def main() -> None:
     state, _ = create_sharded_train_state(wrapper, opt, mesh, jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
 
-    def loss_fn(params, micro, rng):
-        return wrapper.loss(params, micro["text"], train=True)
+    def loss_fn(params, micro, rng, fp8_state=None):
+        return wrapper.loss(params, micro["text"], train=True, fp8_state=fp8_state)
 
     step_fn = make_train_step(loss_fn, opt, gradient_accumulation_steps=args.accum)
     tokens = np.random.RandomState(0).randint(
