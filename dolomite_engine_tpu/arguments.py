@@ -20,6 +20,8 @@ from argparse import ArgumentParser
 from enum import Enum
 from typing import Any
 
+from pydantic import model_validator
+
 from .defaults import INPUT_FORMAT, OUTPUT_FORMAT
 from .enums import (
     AttentionImplementation,
@@ -77,6 +79,12 @@ class ModelArgs(BaseArgs):
     reset_attention_mask: bool = False
     # whether to reset position ids at document boundaries for pretraining
     reset_position_ids: bool = False
+    # extra config fields merged over the model config (reference configs e.g.
+    # instruction_tuning/bloom-3b-slimorca-training.yml use this shape)
+    config_extras: dict | None = None
+    # MoE compute path: scattermoe/scatter (ragged grouped GEMM), eager, auto
+    # (reference configs/testing/scattermoe.yml)
+    moe_implementation: str | None = None
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None([(self.model_class, "model_class")])
@@ -279,6 +287,16 @@ class OptimizerArgs(BaseArgs):
 
 
 class LRSchedulerArgs(BaseArgs):
+    @model_validator(mode="before")
+    @classmethod
+    def _alias_lr_schedule(cls, data):
+        # older reference configs (e.g. instruction_tuning/bloom-3b-slimorca-training.yml)
+        # spell lr_decay_style as lr_schedule
+        if isinstance(data, dict) and "lr_schedule" in data and "lr_decay_style" not in data:
+            data = dict(data)
+            data["lr_decay_style"] = data.pop("lr_schedule")
+        return data
+
     # warmup steps
     num_warmup_steps: int = 200
     # constant steps after warmup and before decay

@@ -53,6 +53,7 @@ class ModelWrapper:
         neft_alpha: float | None = None,
         trust_remote_code: bool = False,
         model_kwargs: dict | None = None,
+        config_extras: dict | None = None,
     ) -> None:
         self.mode = mode
         self.model_name = model_name
@@ -83,6 +84,7 @@ class ModelWrapper:
             attention_implementation = AttentionImplementation.sdpa
         self.attention_implementation = attention_implementation
 
+        self.config_extras = config_extras
         self._setup_config(model_name, pretrained_config)
         self._setup_tokenizer(tokenizer_name, additional_special_tokens)
 
@@ -97,9 +99,16 @@ class ModelWrapper:
 
     # ------------------------------------------------------------------ setup
     def _setup_config(self, model_name: str | None, pretrained_config: dict | None) -> None:
+        def _build(config_dict: dict):
+            # config_extras: extra knobs layered over the (loaded or inline) config dict
+            # (reference model_args.config_extras config shape)
+            if self.config_extras:
+                config_dict = dict(config_dict, **self.config_extras)
+            return config_from_dict(config_dict)
+
         if model_name is None:
             assert pretrained_config is not None
-            self.config = config_from_dict(pretrained_config)
+            self.config = _build(pretrained_config)
         else:
             import json
             import os
@@ -129,7 +138,7 @@ class ModelWrapper:
             # the local path from here on
             model_name = resolve_model_path(model_name)
             self.model_name = model_name
-            self.config = config_from_dict(config_dict)
+            self.config = _build(config_dict)
         self.model_type = self.config.model_type
 
     def _setup_tokenizer(

@@ -146,10 +146,19 @@ def get_model(args, mode: Mode):
 
     tuning_method = args.tuning_args.tuning_method
 
+    model_kwargs = {}
+    if args.model_args.moe_implementation is not None:
+        # reference name "scattermoe" -> this repo's ragged grouped-GEMM path "scatter"
+        model_kwargs["moe_implementation"] = {"scattermoe": "scatter"}.get(
+            args.model_args.moe_implementation, args.model_args.moe_implementation
+        )
+
     common = dict(
         mode=mode,
         model_name=args.model_args.model_name,
         pretrained_config=args.model_args.pretrained_config,
+        config_extras=args.model_args.config_extras,
+        model_kwargs=model_kwargs or None,
         model_class=args.model_args.model_class,
         dtype=args.mixed_precision_args.dtype,
         efficient_initialization=args.model_args.efficient_initialization,
