@@ -1,0 +1,9 @@
+#!/bin/bash
+# Multi-host finetuning launcher (parity: reference `scripts/finetune.sh`). Same contract as
+# pretrain_pod.sh: run this same script on every pod host; jax.distributed.initialize()
+# discovers the coordinator from the TPU metadata (or JAX_COORDINATOR_ADDRESS/
+# JAX_PROCESS_COUNT/JAX_PROCESS_INDEX for manual rendezvous).
+set -euo pipefail
+CONFIG=${1:?"usage: finetune_pod.sh <config.yml>"}
+export TOKENIZERS_PARALLELISM=false
+exec python -m dolomite_engine_tpu.finetune --config "$CONFIG"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# Parity: reference `scripts/generate.sh`.
+python -m dolomite_engine_tpu.generate --config ${1}

@@ -1,0 +1,3 @@
+#!/bin/bash
+# Parity: reference `scripts/unshard.sh`.
+python -m dolomite_engine_tpu.unshard --config ${1}
