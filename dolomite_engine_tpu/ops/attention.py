@@ -117,6 +117,71 @@ def sdpa_attention(
     )
 
 
+def _use_splash_kernel() -> bool:
+    """Opt-in switch for the splash-attention kernel (the production MaxText kernel: GQA
+    without KV-head repetition, fused bwd option). Numerics are pinned by tests in interpret
+    mode; it stays opt-in until measured against the legacy flash kernel on hardware
+    (PROFILE.md pending list)."""
+    import os
+
+    return os.environ.get("DOLOMITE_SPLASH_ATTENTION", "0") == "1"
+
+
+def _tpu_splash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: jax.Array | None,
+    softmax_scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA-native Pallas splash attention: K/V keep their kv-head count (no `_repeat_kv`
+    HBM blowup); the kernel maps q head h to kv head h // (Hq // Hkv). Causal-only (alibi
+    needs an additive bias splash's mask objects don't express)."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _sm,
+    )
+
+    qt = jnp.swapaxes(q, 1, 2)  # [B, Hq, S, D]
+    kt = jnp.swapaxes(k, 1, 2)  # [B, Hkv, S, D]
+    vt = jnp.swapaxes(v, 1, 2)
+    num_q_heads = qt.shape[1]
+    sq, skv = qt.shape[2], kt.shape[2]
+
+    def _pick(length: int) -> int:
+        for block in (512, 256, 128):
+            if length % block == 0:
+                return block
+        return min(128, length)
+
+    bq, bkv = _pick(sq), _pick(skv)
+    block_sizes = _sk.BlockSizes(
+        block_q=bq,
+        block_kv=bkv,
+        block_kv_compute=bkv,
+        block_q_dkv=bq,
+        block_kv_dkv=bkv,
+        block_kv_dkv_compute=bkv,
+        block_q_dq=bq,
+        block_kv_dq=bkv,
+    )
+    mask = _sm.MultiHeadMask([_sm.CausalMask((sq, skv)) for _ in range(num_q_heads)])
+    kernel = _sk.make_splash_mha_single_device(
+        mask, block_sizes=block_sizes, interpret=interpret
+    )
+
+    qs = qt * softmax_scale  # splash has no sm_scale argument
+    if segment_ids is None:
+        out = jax.vmap(lambda a, b, c: kernel(a, b, c))(qs, kt, vt)
+    else:
+        seg = segment_ids.astype(jnp.int32)
+        out = jax.vmap(
+            lambda a, b, c, s: kernel(a, b, c, segment_ids=_sk.SegmentIds(q=s, kv=s))
+        )(qs, kt, vt, seg)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def _tpu_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -272,6 +337,8 @@ def attention(
         and q.shape[1] % 128 == 0  # kernel tiling requires block | seq
     )
     if use_flash:
+        if causal and alibi_bias is None and _use_splash_kernel():
+            return _tpu_splash_attention(q, k, v, segment_ids, softmax_scale)
         return _tpu_flash_attention(q, k, v, alibi_bias, segment_ids, causal, softmax_scale)
 
     if segment_ids is not None and q.shape[1] != k.shape[1]:

@@ -71,3 +71,37 @@ def test_ring_without_sp_mesh_falls_back(caplog):
     out_ring = attention(q, k, v, implementation=AttentionImplementation.ring)
     out_sdpa = attention(q, k, v, implementation=AttentionImplementation.sdpa)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_sdpa), atol=1e-6)
+
+
+def test_splash_attention_matches_sdpa_interpret():
+    """Splash kernel (interpret mode) == sdpa for causal GQA with packed segment ids, fwd and
+    grad. On TPU this is the opt-in DOLOMITE_SPLASH_ATTENTION=1 path (no KV-head repeat)."""
+    from dolomite_engine_tpu.ops.attention import _tpu_splash_attention, sdpa_attention, make_attention_mask
+
+    rng = np.random.RandomState(0)
+    B, S, Hq, Hkv, D = 2, 256, 4, 2, 64
+    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    seg = jnp.asarray(
+        np.r_[np.full(96, 1), np.full(96, 2), np.full(64, 3)][None].repeat(B, 0), jnp.int32
+    )
+    scale = D**-0.5
+
+    def splash(q, k, v):
+        return _tpu_splash_attention(q, k, v, seg, scale, interpret=True)
+
+    def ref(q, k, v):
+        from dolomite_engine_tpu.ops.attention import _repeat_kv
+
+        mask = make_attention_mask(B, S, S, causal=True, segment_ids_q=seg)
+        return sdpa_attention(_repeat_kv(q, Hq), _repeat_kv(k, Hq), _repeat_kv(v, Hq), mask, None, scale)
+
+    out = splash(q, k, v)
+    expected = ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    g_s = jax.grad(lambda a, b, c: splash(a, b, c).sum(), argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda a, b, c: ref(a, b, c).sum(), argnums=(0, 1, 2))(q, k, v)
+    for s_, r_ in zip(g_s, g_r):
+        np.testing.assert_allclose(np.asarray(s_), np.asarray(r_), atol=5e-5, rtol=5e-5)

@@ -39,7 +39,11 @@ def main() -> None:
     p.add_argument("--fused_loss", action="store_true", help="chunked LM-head loss (no full logits)")
     p.add_argument("--loss_chunk", type=int, default=256)
     p.add_argument("--profile", type=str, default=None, help="jax.profiler trace dir")
+    p.add_argument("--splash", action="store_true", help="use the splash attention kernel")
     args = p.parse_args()
+
+    if args.splash:
+        os.environ["DOLOMITE_SPLASH_ATTENTION"] = "1"
 
     from dolomite_engine_tpu.enums import AttentionImplementation, LRDecaySchedule, Mode
     from dolomite_engine_tpu.model_wrapper.pretraining import ModelWrapperForPretraining
