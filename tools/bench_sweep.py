@@ -40,6 +40,7 @@ def main() -> None:
     p.add_argument("--loss_chunk", type=int, default=256)
     p.add_argument("--profile", type=str, default=None, help="jax.profiler trace dir")
     p.add_argument("--splash", action="store_true", help="use the splash attention kernel")
+    p.add_argument("--packed", action="store_true", help="packed segment-ids path (reset_attention_mask)")
     args = p.parse_args()
 
     if args.splash:
@@ -90,7 +91,8 @@ def main() -> None:
             if backend == "tpu"
             else AttentionImplementation.sdpa
         ),
-        reset_attention_mask=False,
+        reset_attention_mask=args.packed,
+        reset_position_ids=args.packed,
         zero_stage=3,
         gradient_checkpointing_args=gc_args,
     )
