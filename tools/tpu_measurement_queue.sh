@@ -1,0 +1,29 @@
+#!/bin/bash
+# Queued on-chip measurements from round 3 (the axon tunnel died mid-round — PROFILE.md
+# step 4). Run this first thing when a chip is reachable; each line is one A/B from the
+# PROFILE.md pending list. Waits (up to ~7h) for the chip, then measures.
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
+    echo "=== TPU recovered at $(date)"
+    echo "=== accum16 confirm"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --steps 5 2>&1 | tail -1
+    echo "=== splash kernel A/B"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
+    echo "=== 2048x12 mu_bf16"
+    timeout 900 python tools/bench_sweep.py --n_embd 2048 --n_layer 12 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --mu_dtype bfloat16 --steps 5 2>&1 | tail -1
+    echo "=== fp8 variant"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 8 --fused_loss --dtype fp8 --steps 5 2>&1 | tail -1
+    echo "=== packed segment-ids variant"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --packed --steps 5 2>&1 | tail -1
+    echo "=== generation bench"
+    timeout 900 python tools/bench_generation.py 2>&1 | tail -1
+    echo "=== bench.py (driver config)"
+    timeout 1200 python bench.py 2>&1 | tail -1
+    echo "=== done at $(date)"
+    exit 0
+  fi
+  sleep 120
+done
+echo "TPU never recovered"
+exit 1
