@@ -18,8 +18,38 @@ import numpy as np
 _PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
 
 
+def _probe_backend(timeout_s: float = 600.0) -> str:
+    """Resolve the backend with a watchdog: a wedged TPU claim (axon lease, PROFILE.md step 4)
+    hangs jax.default_backend() forever — better one parseable bench_error line than a hang."""
+    import threading
+
+    result: list[str] = []
+
+    def probe():
+        jax.jit(lambda x: x * 2)(jnp.ones(4))  # force a real claim, not just plugin discovery
+        result.append(jax.default_backend())
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        print(
+            json.dumps(
+                {
+                    "metric": "bench_error",
+                    "value": 0,
+                    "unit": f"TPU claim did not complete within {timeout_s:.0f}s "
+                    "(wedged tunnel lease; see PROFILE.md step 4)",
+                    "vs_baseline": 0,
+                }
+            )
+        )
+        sys.exit(1)
+    return result[0]
+
+
 def main() -> None:
-    backend = jax.default_backend()
+    backend = _probe_backend()
     on_tpu = backend == "tpu"
 
     from dolomite_engine_tpu.enums import LRDecaySchedule, Mode
