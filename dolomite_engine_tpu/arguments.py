@@ -100,6 +100,10 @@ class ModelArgs(BaseArgs):
             f"unexpected model_class ({self.model_class})"
         )
 
+        assert self.moe_implementation in [None, "scattermoe", "scatter", "eager", "auto"], (
+            f"unexpected moe_implementation ({self.moe_implementation})"
+        )
+
 
 class PromptTuningArgs(BaseArgs):
     # prompt tuning init method

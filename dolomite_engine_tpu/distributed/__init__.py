@@ -48,6 +48,16 @@ def get_data_parallel_world_size(args) -> int:
     return max(jax.device_count() // model_parallel, 1)
 
 
+def _with_memory_kind(sharding_tree, kind: str):
+    """Rewrite every NamedSharding leaf to the given memory space, layout untouched."""
+    return jax.tree.map(
+        lambda s: NamedSharding(s.mesh, s.spec, memory_kind=kind)
+        if isinstance(s, NamedSharding)
+        else s,
+        sharding_tree,
+    )
+
+
 def get_state_shardings(
     model: ModelWrapper,
     optimizer: optax.GradientTransformation,
@@ -89,12 +99,7 @@ def get_state_shardings(
     )
     if offload_optimizer:
         # same layout, host memory space; jax transfers to HBM lazily at use
-        opt_shardings = jax.tree.map(
-            lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
-            if isinstance(s, NamedSharding)
-            else s,
-            opt_shardings,
-        )
+        opt_shardings = _with_memory_kind(opt_shardings, "pinned_host")
     replicated = NamedSharding(mesh, PartitionSpec())
     shardings = TrainState(
         step=replicated,
@@ -137,12 +142,7 @@ def create_sharded_train_state(
     device_shardings = shardings
     if offload_optimizer:
         device_shardings = shardings.replace(
-            opt_state=jax.tree.map(
-                lambda s: NamedSharding(s.mesh, s.spec, memory_kind="device")
-                if isinstance(s, NamedSharding)
-                else s,
-                shardings.opt_state,
-            )
+            opt_state=_with_memory_kind(shardings.opt_state, "device")
         )
     with mesh, model.fp8_scope():
         state = jax.jit(_init, out_shardings=device_shardings)()

@@ -555,13 +555,30 @@ _MODEL_EXPORT_FUNCTIONS = {
 
 def import_from_huggingface(pretrained_model_name_or_path: str, save_path: str) -> None:
     """Reference `model_conversion/__init__.py:19-27`; hub ids are snapshot-downloaded first
-    (reference builds on utils/hf_hub.py the same way)."""
+    (reference builds on utils/hf_hub.py the same way). Repos shipping only torch-pickle
+    weights (pytorch_model*.bin, e.g. the bloom family) are converted to safetensors in a
+    staging dir via the tools/pt_to_safetensors machinery before import."""
+    import glob as _glob
+    import tempfile
+
     from ..utils.hf_hub import resolve_model_path
 
-    pretrained_model_name_or_path = resolve_model_path(pretrained_model_name_or_path)
+    pretrained_model_name_or_path = resolve_model_path(
+        pretrained_model_name_or_path, include_torch_bin=True
+    )
     model_type = _read_config(pretrained_model_name_or_path)["model_type"]
     if model_type not in _MODEL_IMPORT_FUNCTIONS:
         raise NotImplementedError(f"the current model_type ({model_type}) is not yet supported")
+
+    has_safetensors = _glob.glob(os.path.join(pretrained_model_name_or_path, "*.safetensors"))
+    has_bin = _glob.glob(os.path.join(pretrained_model_name_or_path, "pytorch_model*.bin"))
+    if not has_safetensors and has_bin:
+        from ..utils.safetensors import torch_bin_to_safetensors
+
+        staging = tempfile.mkdtemp(prefix="dolomite-bin-convert-")
+        torch_bin_to_safetensors(pretrained_model_name_or_path, staging)
+        pretrained_model_name_or_path = staging
+
     _MODEL_IMPORT_FUNCTIONS[model_type](pretrained_model_name_or_path, save_path)
 
 

@@ -160,7 +160,7 @@ class SparseMoE(nn.Module):
 
         from ..parallel.mesh import MeshManager
 
-        impl = self.moe_implementation
+        impl = {"scattermoe": "scatter"}.get(self.moe_implementation, self.moe_implementation)
         if impl == "auto":
             impl = "scatter" if jax.default_backend() == "tpu" else "eager"
         if MeshManager.is_initialized() and MeshManager.axis_size("ep") > 1:
@@ -222,9 +222,15 @@ class SparseMoE(nn.Module):
                 act,
                 config.num_experts,
             )
-        else:
+        elif impl == "eager":
             combine = combine_weights(router_weights, selected_experts, config.num_experts)
             out = experts_eager(x.astype(self.dtype), combine, w_fc, b_fc, w_proj, b_proj, act)
+        else:
+            # a typo'd name must not silently run the dense all-gather path
+            raise ValueError(
+                f"unknown moe_implementation '{self.moe_implementation}' "
+                "(expected scatter/scattermoe, eager, or auto)"
+            )
 
         out = out.reshape(batch, seq, hidden_size)
         out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)

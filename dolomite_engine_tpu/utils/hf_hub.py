@@ -26,27 +26,33 @@ TOKENIZER_FILES = (
     "generation_config.json",
 )
 
-# + safetensors weights — the file set the reference downloads (pytorch .bin weights are NOT
-# fetched: conversion is safetensors-based, tools/pt_to_safetensors exists for local .bin
-# checkpoints)
+# + safetensors weights — the file set the reference downloads. Torch-pickle weights are
+# fetched only on demand (include_torch_bin): hf_interop.import_from_huggingface pulls them
+# for .bin-only repos and converts via the pt_to_safetensors machinery.
 _SNAPSHOT_PATTERNS = ["*.safetensors", "*.safetensors.index.json", *TOKENIZER_FILES]
+_TORCH_BIN_PATTERNS = ["pytorch_model*.bin", "pytorch_model.bin.index.json"]
 
 
-def resolve_model_path(repo_name_or_path: str, config_only: bool = False) -> str:
+def resolve_model_path(
+    repo_name_or_path: str, config_only: bool = False, include_torch_bin: bool = False
+) -> str:
     """Return a local directory for `repo_name_or_path` (reference `download_repo` semantics).
 
     A local directory is returned unchanged; anything else is treated as a hub repo id and
     snapshot-downloaded (config + tokenizer + safetensors; just config.json when
-    `config_only` — callers validate model_type BEFORE pulling GBs of weights). Raises
-    ValueError when the name is neither a local dir nor a resolvable hub repo (e.g.
-    zero-egress environments)."""
+    `config_only` — callers validate model_type BEFORE pulling GBs of weights;
+    `include_torch_bin` adds pytorch_model*.bin for .bin-only repos). Raises ValueError when
+    the name is neither a local dir nor a resolvable hub repo (e.g. zero-egress
+    environments)."""
     if os.path.isdir(repo_name_or_path):
         return repo_name_or_path
 
     try:
         from huggingface_hub import snapshot_download
 
-        patterns = ["config.json"] if config_only else _SNAPSHOT_PATTERNS
+        patterns = ["config.json"] if config_only else list(_SNAPSHOT_PATTERNS)
+        if include_torch_bin and not config_only:
+            patterns += _TORCH_BIN_PATTERNS
         return snapshot_download(repo_name_or_path, allow_patterns=patterns)
     except Exception as e:
         raise ValueError(

@@ -110,3 +110,54 @@ class SafeTensorsWeightsManager:
         index = {"metadata": {"total_size": int(sum(shard_sizes))}, "weight_map": weight_map}
         with open(os.path.join(save_path, _INDEX_NAME), "w") as f:
             json.dump(index, f, indent=2)
+
+
+def torch_bin_to_safetensors(checkpoint_dir: str, dest_dir: str) -> int:
+    """Convert a torch-pickle HF checkpoint (`pytorch_model*.bin`) to sharded safetensors,
+    copying tokenizer/config sidecars. Returns the tensor count.
+
+    Parity: reference `tools/pt_to_safetensors.py` (AutoModel load + save_pretrained); here
+    the state dicts are read directly — dtype-preserving (incl. bf16 via ml_dtypes), no model
+    instantiation, any architecture. CLI: tools/pt_to_safetensors.py; hub .bin-only repos go
+    through this in hf_interop.import_from_huggingface."""
+    import shutil
+
+    import torch
+
+    from .hf_hub import TOKENIZER_FILES
+
+    index_path = os.path.join(checkpoint_dir, "pytorch_model.bin.index.json")
+    if os.path.isfile(index_path):
+        with open(index_path) as f:
+            files = sorted(set(json.load(f)["weight_map"].values()))
+    else:
+        files = sorted(
+            f for f in os.listdir(checkpoint_dir)
+            if f.startswith("pytorch_model") and f.endswith(".bin")
+        )
+    if not files:
+        raise FileNotFoundError(f"no pytorch_model*.bin found in {checkpoint_dir}")
+
+    state_dict: dict = {}
+    for fname in files:
+        shard = torch.load(
+            os.path.join(checkpoint_dir, fname), map_location="cpu", weights_only=True
+        )
+        state_dict.update(shard)
+
+    def to_numpy(t):
+        # numpy has no bfloat16: go through ml_dtypes (safetensors-numpy understands it)
+        if t.dtype == torch.bfloat16:
+            import ml_dtypes
+
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return t.numpy()
+
+    SafeTensorsWeightsManager.save_state_dict(
+        {name: to_numpy(t) for name, t in state_dict.items()}, dest_dir
+    )
+    for fname in TOKENIZER_FILES:
+        src = os.path.join(checkpoint_dir, fname)
+        if os.path.isfile(src):
+            shutil.copy2(src, os.path.join(dest_dir, fname))
+    return len(state_dict)

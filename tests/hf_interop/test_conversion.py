@@ -279,3 +279,34 @@ def test_granitemoe_roundtrip(tmp_path):
 
     export_to_huggingface(dolomite_path, roundtrip_path, model_type="granitemoe")
     assert SafeTensorsWeightsManager(hf_path) == SafeTensorsWeightsManager(roundtrip_path)
+
+
+def test_import_bin_only_checkpoint(tmp_path):
+    """A checkpoint shipping only pytorch_model.bin (no safetensors) imports via the
+    automatic staging conversion (utils.safetensors.torch_bin_to_safetensors) — the
+    .bin-only hub-repo path of import_from_huggingface."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dolomite_engine_tpu.hf_interop import import_from_huggingface
+
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        attention_bias=False,
+    )
+    model = LlamaForCausalLM(config)
+    src = tmp_path / "bin-ckpt"
+    model.save_pretrained(src, safe_serialization=False)  # pytorch_model.bin only
+    assert (src / "pytorch_model.bin").is_file()
+
+    dst = tmp_path / "dolomite"
+    import_from_huggingface(str(src), str(dst))
+
+    mgr = SafeTensorsWeightsManager(str(dst))
+    assert len(mgr) > 0
+    ref_sd = model.state_dict()
+    np.testing.assert_allclose(
+        mgr.get_tensor("transformer.wte.weight"),
+        ref_sd["model.embed_tokens.weight"].numpy(),
+    )

@@ -2,70 +2,23 @@
 
 Parity: reference `tools/pt_to_safetensors.py` (loads a .bin checkpoint with
 AutoModelForCausalLM and re-saves with save_pretrained, which emits safetensors, then copies
-the tokenizer). Here we do the same without instantiating the model: read the torch state
-dict(s) directly (torch CPU is available in this image), convert to numpy, and write
-size-sharded safetensors + index via SafeTensorsWeightsManager — dtype-preserving and
-works for any architecture, not just registered ones.
+the tokenizer). Thin CLI over `utils.safetensors.torch_bin_to_safetensors` (also used by
+`hf_interop.import_from_huggingface` for .bin-only hub repos).
 
 Usage: python tools/pt_to_safetensors.py <checkpoint_dir> <safetensors_dest_dir>
 """
 
-import json
 import os
-import shutil
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-import numpy as np  # noqa: E402
-import torch  # noqa: E402
-
-from dolomite_engine_tpu.utils.hf_hub import TOKENIZER_FILES as _TOKENIZER_FILES  # noqa: E402
-from dolomite_engine_tpu.utils.safetensors import SafeTensorsWeightsManager  # noqa: E402
-
-
-def _load_torch_state_dict(checkpoint_dir: str) -> dict[str, torch.Tensor]:
-    index_path = os.path.join(checkpoint_dir, "pytorch_model.bin.index.json")
-    if os.path.isfile(index_path):
-        with open(index_path) as f:
-            files = sorted(set(json.load(f)["weight_map"].values()))
-    else:
-        files = sorted(
-            f for f in os.listdir(checkpoint_dir)
-            if f.startswith("pytorch_model") and f.endswith(".bin")
-        )
-    if not files:
-        raise FileNotFoundError(f"no pytorch_model*.bin found in {checkpoint_dir}")
-
-    state_dict: dict[str, torch.Tensor] = {}
-    for fname in files:
-        shard = torch.load(
-            os.path.join(checkpoint_dir, fname), map_location="cpu", weights_only=True
-        )
-        state_dict.update(shard)
-    return state_dict
-
-
-def _to_numpy(t: torch.Tensor) -> np.ndarray:
-    # numpy has no bfloat16: go through ml_dtypes (safetensors-numpy understands it)
-    if t.dtype == torch.bfloat16:
-        import ml_dtypes
-
-        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
-    return t.numpy()
+from dolomite_engine_tpu.utils.safetensors import torch_bin_to_safetensors  # noqa: E402
 
 
 def convert(checkpoint_dir: str, dest_dir: str) -> None:
-    state_dict = _load_torch_state_dict(checkpoint_dir)
-    SafeTensorsWeightsManager.save_state_dict(
-        {name: _to_numpy(t) for name, t in state_dict.items()}, dest_dir
-    )
-    # move the tokenizer + config alongside (reference does this via AutoTokenizer round-trip)
-    for fname in _TOKENIZER_FILES:
-        src = os.path.join(checkpoint_dir, fname)
-        if os.path.isfile(src):
-            shutil.copy2(src, os.path.join(dest_dir, fname))
-    print(f"wrote {len(state_dict)} tensors -> {dest_dir}")
+    n = torch_bin_to_safetensors(checkpoint_dir, dest_dir)
+    print(f"wrote {n} tensors -> {dest_dir}")
 
 
 if __name__ == "__main__":

@@ -4,7 +4,7 @@
 # PROFILE.md pending list. Waits (up to ~7h) for the chip, then measures.
 cd /root/repo
 for i in $(seq 1 200); do
-  if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
+  if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
     echo "=== TPU recovered at $(date)"
     echo "=== accum16 confirm"
     timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --steps 5 2>&1 | tail -1
