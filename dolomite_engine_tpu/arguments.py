@@ -32,6 +32,7 @@ from .enums import (
     LossMask,
     LRDecaySchedule,
     Mode,
+    MOE_IMPLEMENTATIONS,
     ParamsGroupMethod,
     TuningMethod,
 )
@@ -100,7 +101,7 @@ class ModelArgs(BaseArgs):
             f"unexpected model_class ({self.model_class})"
         )
 
-        assert self.moe_implementation in [None, "scattermoe", "scatter", "eager", "auto"], (
+        assert self.moe_implementation is None or self.moe_implementation in MOE_IMPLEMENTATIONS, (
             f"unexpected moe_implementation ({self.moe_implementation})"
         )
 
@@ -209,6 +210,10 @@ class SaveArgs(BaseArgs):
     save_interval: int = None
     # whether to save optimizer
     save_optimizer: bool = True
+    # overlap checkpoint disk writes with training (TPU-native extension, not in the
+    # reference): the device->host copy is synchronous, the serialization+write runs in a
+    # background thread; the `latest` pointer is only advanced once the write commits
+    async_checkpointing: bool = False
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None([(self.save_path, "save_path"), (self.save_interval, "save_interval")])

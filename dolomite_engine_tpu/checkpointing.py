@@ -98,6 +98,49 @@ def set_rng_state(state: dict) -> jax.Array | None:
 
 # --------------------------------------------------------------------------------- save
 
+# one process-wide async-capable checkpointer: orbax's StandardCheckpointer copies
+# device->host synchronously inside save() and runs serialization + disk writes on a
+# background thread; reusing one instance lets consecutive saves pipeline
+_CHECKPOINTER: ocp.StandardCheckpointer | None = None
+# (save_path, iteration) of a started-but-not-yet-committed async save; its `latest`
+# pointer is written by finish_pending_checkpoint() once the write is durable
+_PENDING: tuple[str, int] | None = None
+
+
+def _get_checkpointer() -> ocp.StandardCheckpointer:
+    global _CHECKPOINTER
+    if _CHECKPOINTER is None:
+        _CHECKPOINTER = ocp.StandardCheckpointer()
+    return _CHECKPOINTER
+
+
+def finish_pending_checkpoint() -> None:
+    """Block until an in-flight async save commits, then advance the `latest` pointer.
+
+    Called at the start of the next save (so at most one save is in flight), at the end of
+    training, and before any in-process restore. Crash-safety: the pointer is only written
+    after `wait_until_finished`, so `latest` can never name a torn checkpoint — a crash
+    mid-write loses at most the in-flight save, never the previous one.
+    """
+    global _PENDING
+    if _PENDING is None:
+        return
+    save_path, iteration = _PENDING
+    _PENDING = None
+    _get_checkpointer().wait_until_finished()
+    _write_latest(save_path, iteration)
+
+
+def _write_latest(save_path: str, iteration: int) -> None:
+    if _is_primary():
+        # tmp + rename: a crash mid-write must never leave a torn pointer file — that would
+        # break resume from EVERY checkpoint, not just lose the in-flight one
+        target = os.path.join(save_path, _LATEST)
+        tmp = target + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"latest_checkpointed_iteration": iteration}, f)
+        os.replace(tmp, target)
+
 
 def save_checkpoint(
     args: TrainingArgs,
@@ -111,6 +154,8 @@ def save_checkpoint(
 ) -> None:
     """Save a full training checkpoint (reference `save_checkpoint`, checkpointing.py:50-146)."""
     save_path = args.save_args.save_path
+    is_async = bool(getattr(args.save_args, "async_checkpointing", False))
+    finish_pending_checkpoint()  # at most one save in flight
     base = _get_base_path(save_path, iteration)
     os.makedirs(base, exist_ok=True)
 
@@ -118,9 +163,10 @@ def save_checkpoint(
     if not args.save_args.save_optimizer:
         to_save = TrainState(step=state.step, params=state.params, opt_state=(), fp8=state.fp8)
 
-    checkpointer = ocp.StandardCheckpointer()
+    checkpointer = _get_checkpointer()
     checkpointer.save(os.path.abspath(_state_path(base)), to_save, force=True)
-    checkpointer.wait_until_finished()
+    if not is_async:
+        checkpointer.wait_until_finished()
 
     rng_path = os.path.join(base, f"rng_state-{jax.process_index()}.json")
     with open(rng_path, "w") as f:
@@ -143,10 +189,13 @@ def save_checkpoint(
 
         save_args(args, base)
 
-        with open(os.path.join(save_path, _LATEST), "w") as f:
-            json.dump({"latest_checkpointed_iteration": iteration}, f)
+    if is_async:
+        global _PENDING
+        _PENDING = (save_path, iteration)  # `latest` advances once the write commits
+    else:
+        _write_latest(save_path, iteration)
 
-    log_rank_0(logging.INFO, f"checkpoint saved at {base}")
+    log_rank_0(logging.INFO, f"checkpoint saved at {base}" + (" (async)" if is_async else ""))
 
 
 def save_args(args, base: str, mode: Mode = Mode.training) -> None:
@@ -212,6 +261,7 @@ def load_checkpoint_for_training(
     if load_args is None:
         return state, 0, None, None
 
+    finish_pending_checkpoint()  # an in-flight async save may be the one being restored
     load_path = load_args.load_path
     if iteration is None:
         iteration = load_args.iteration
@@ -357,6 +407,7 @@ def load_checkpoint_for_inference(
     from .model_wrapper import get_model
     from .parallel.mesh import MeshManager
 
+    finish_pending_checkpoint()  # an in-flight async save may be the one being restored
     load_args = args.load_args
     load_path = load_args.load_path
     iteration = load_args.iteration

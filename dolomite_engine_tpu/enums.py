@@ -116,3 +116,14 @@ class FIMMode(Enum):
 
     psm = "psm"
     spm = "spm"
+
+
+# MoE compute-path names. Not an Enum: configs also accept None (model default) and the
+# reference spelling "scattermoe" (configs/testing/scattermoe.yml), normalized here once for
+# the arguments validator, the model wrapper, and the model's dispatch.
+MOE_IMPLEMENTATIONS = ("scattermoe", "scatter", "eager", "auto")
+
+
+def normalize_moe_implementation(value: str) -> str:
+    """Reference name "scattermoe" -> this repo's ragged grouped-GEMM path "scatter"."""
+    return {"scattermoe": "scatter"}.get(value, value)

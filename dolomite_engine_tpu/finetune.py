@@ -23,6 +23,7 @@ from .arguments import TrainingArgs, get_args
 from .checkpointing import (
     get_experiments_tracker_checkpoint_metadata,
     load_checkpoint_for_training,
+    finish_pending_checkpoint,
     save_checkpoint,
 )
 from .data import get_dataloader, infinite_iterator
@@ -185,6 +186,8 @@ def train(
                 global_step,
                 jax_rng=jax_rng,
             )
+
+    finish_pending_checkpoint()  # commit an in-flight async save before exiting
 
     # final eval only when the loop didn't just run one at this step (reference finetune.py
     # evaluates only in-loop)

@@ -559,27 +559,45 @@ def import_from_huggingface(pretrained_model_name_or_path: str, save_path: str) 
     weights (pytorch_model*.bin, e.g. the bloom family) are converted to safetensors in a
     staging dir via the tools/pt_to_safetensors machinery before import."""
     import glob as _glob
+    import shutil
     import tempfile
 
     from ..utils.hf_hub import resolve_model_path
 
-    pretrained_model_name_or_path = resolve_model_path(
-        pretrained_model_name_or_path, include_torch_bin=True
-    )
-    model_type = _read_config(pretrained_model_name_or_path)["model_type"]
+    # validate model_type from config.json alone BEFORE pulling GBs of weights
+    # (hf_hub.resolve_model_path's contract); then safetensors-first: only re-resolve with
+    # the torch-pickle patterns when the snapshot has no *.safetensors, so dual-format repos
+    # don't download .bin shards just to discard them
+    config_dir = resolve_model_path(pretrained_model_name_or_path, config_only=True)
+    model_type = _read_config(config_dir)["model_type"]
     if model_type not in _MODEL_IMPORT_FUNCTIONS:
         raise NotImplementedError(f"the current model_type ({model_type}) is not yet supported")
 
+    resolved = resolve_model_path(pretrained_model_name_or_path)
+    if not _glob.glob(os.path.join(resolved, "*.safetensors")):
+        resolved = resolve_model_path(pretrained_model_name_or_path, include_torch_bin=True)
+    pretrained_model_name_or_path = resolved
+
     has_safetensors = _glob.glob(os.path.join(pretrained_model_name_or_path, "*.safetensors"))
     has_bin = _glob.glob(os.path.join(pretrained_model_name_or_path, "pytorch_model*.bin"))
-    if not has_safetensors and has_bin:
-        from ..utils.safetensors import torch_bin_to_safetensors
+    if not has_safetensors and not has_bin:
+        raise ValueError(
+            f"no supported weight format found in '{pretrained_model_name_or_path}' "
+            "(expected *.safetensors or pytorch_model*.bin)"
+        )
+    staging = None
+    try:
+        if not has_safetensors:
+            from ..utils.safetensors import torch_bin_to_safetensors
 
-        staging = tempfile.mkdtemp(prefix="dolomite-bin-convert-")
-        torch_bin_to_safetensors(pretrained_model_name_or_path, staging)
-        pretrained_model_name_or_path = staging
+            staging = tempfile.mkdtemp(prefix="dolomite-bin-convert-")
+            torch_bin_to_safetensors(pretrained_model_name_or_path, staging)
+            pretrained_model_name_or_path = staging
 
-    _MODEL_IMPORT_FUNCTIONS[model_type](pretrained_model_name_or_path, save_path)
+        _MODEL_IMPORT_FUNCTIONS[model_type](pretrained_model_name_or_path, save_path)
+    finally:
+        if staging is not None:
+            shutil.rmtree(staging, ignore_errors=True)
 
 
 def export_to_huggingface(pretrained_model_name_or_path: str, save_path: str, model_type: str) -> None:

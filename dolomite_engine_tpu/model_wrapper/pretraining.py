@@ -148,9 +148,10 @@ def get_model(args, mode: Mode):
 
     model_kwargs = {}
     if args.model_args.moe_implementation is not None:
-        # reference name "scattermoe" -> this repo's ragged grouped-GEMM path "scatter"
-        model_kwargs["moe_implementation"] = {"scattermoe": "scatter"}.get(
-            args.model_args.moe_implementation, args.model_args.moe_implementation
+        from ..enums import normalize_moe_implementation
+
+        model_kwargs["moe_implementation"] = normalize_moe_implementation(
+            args.model_args.moe_implementation
         )
 
     common = dict(

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..enums import AttentionImplementation
+from ..enums import AttentionImplementation, normalize_moe_implementation
 from ..ops.activations import get_activation_function, is_glu
 from ..ops.moe import (
     combine_weights,
@@ -160,9 +160,16 @@ class SparseMoE(nn.Module):
 
         from ..parallel.mesh import MeshManager
 
-        impl = {"scattermoe": "scatter"}.get(self.moe_implementation, self.moe_implementation)
+        impl = normalize_moe_implementation(self.moe_implementation)
         if impl == "auto":
             impl = "scatter" if jax.default_backend() == "tpu" else "eager"
+        if impl not in ("scatter", "eager"):
+            # validate BEFORE the ep override below can rewrite impl to "ep_a2a" — a typo'd
+            # name must raise, not silently dispatch the EP path
+            raise ValueError(
+                f"unknown moe_implementation '{self.moe_implementation}' "
+                "(expected scatter/scattermoe, eager, or auto)"
+            )
         if MeshManager.is_initialized() and MeshManager.axis_size("ep") > 1:
             # distributed experts: tokens ride an all_to_all across the "ep" axis; the
             # single-device paths below would all-gather every expert bank onto every device.
@@ -222,15 +229,9 @@ class SparseMoE(nn.Module):
                 act,
                 config.num_experts,
             )
-        elif impl == "eager":
+        else:  # "eager" — impl was validated above
             combine = combine_weights(router_weights, selected_experts, config.num_experts)
             out = experts_eager(x.astype(self.dtype), combine, w_fc, b_fc, w_proj, b_proj, act)
-        else:
-            # a typo'd name must not silently run the dense all-gather path
-            raise ValueError(
-                f"unknown moe_implementation '{self.moe_implementation}' "
-                "(expected scatter/scattermoe, eager, or auto)"
-            )
 
         out = out.reshape(batch, seq, hidden_size)
         out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)

@@ -23,6 +23,7 @@ from .arguments import TrainingArgs, get_args
 from .checkpointing import (
     get_experiments_tracker_checkpoint_metadata,
     load_checkpoint_for_training,
+    finish_pending_checkpoint,
     save_checkpoint,
 )
 from .data.megatron import get_megatron_gpt_dataloaders
@@ -248,6 +249,8 @@ def train(
                 jax_rng=jax_rng,
                 metadata={"consumed_samples": consumed_samples},
             )
+
+    finish_pending_checkpoint()  # commit an in-flight async save before exiting
 
     # final test-set evaluation (reference `pretrain.py:216` evaluates test loaders after
     # training; val was already evaluated in-loop at this step when the interval divides)

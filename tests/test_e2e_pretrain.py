@@ -33,7 +33,7 @@ def _write_corpus(tmp_path, num_docs=200, vocab=128, seed=0) -> str:
     return prefix
 
 
-def _training_args(tmp_path, prefix, num_steps=3, load_path=None) -> TrainingArgs:
+def _training_args(tmp_path, prefix, num_steps=3, load_path=None, async_ckpt=False) -> TrainingArgs:
     cfg = dict(
         model_args=dict(
             model_class="AutoModelForCausalLM",
@@ -78,7 +78,9 @@ def _training_args(tmp_path, prefix, num_steps=3, load_path=None) -> TrainingArg
                 ),
             )
         ],
-        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=2),
+        save_args=dict(
+            save_path=str(tmp_path / "ckpt"), save_interval=2, async_checkpointing=async_ckpt
+        ),
         logging_args=dict(log_interval=1),
         random_args=dict(seed=7),
     )
@@ -125,3 +127,33 @@ def test_pretrain_save_resume(tmp_path, stub_tokenizer, eight_devices):
         assert json.load(f)["latest_checkpointed_iteration"] == 5
     with open(ckpt_root / "global_step5" / "metadata.json") as f:
         assert json.load(f)["consumed_samples"] == 160
+
+
+def test_pretrain_async_checkpointing(tmp_path, stub_tokenizer, eight_devices):
+    """async_checkpointing=True: saves at steps 2 and 3 pipeline (the second waits for the
+    first), `latest` is only advanced to committed checkpoints, and a fresh process can
+    resume from the async-saved state."""
+    from dolomite_engine_tpu import checkpointing, pretrain
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    prefix = _write_corpus(tmp_path)
+
+    MeshManager.destroy()
+    args = _training_args(tmp_path, prefix, num_steps=3, async_ckpt=True)
+    pretrain.main(args=args)
+
+    assert checkpointing._PENDING is None  # train() committed the in-flight save
+    ckpt_root = tmp_path / "ckpt"
+    with open(ckpt_root / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 3
+
+    # resume from the async-written checkpoint, itself saving async
+    MeshManager.destroy()
+    args2 = _training_args(
+        tmp_path, prefix, num_steps=4, load_path=str(ckpt_root), async_ckpt=True
+    )
+    pretrain.main(args=args2)
+    with open(ckpt_root / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 4
+    with open(ckpt_root / "global_step4" / "metadata.json") as f:
+        assert json.load(f)["consumed_samples"] == 128

@@ -79,12 +79,14 @@ def main() -> None:
 
     gen = make_generate_fn(model, max_new_tokens=args.new, do_sample=False)
     out, _ = gen(params, ids, mask, rng)
-    jax.block_until_ready(out)  # compile
+    np.asarray(out)  # compile; host fetch — block_until_ready alone has proven unreliable
+    # on the experimental axon platform for non-donated outputs (0.3ms "e2e" readings);
+    # fetching the [B, new] int32 result to host forces real completion at ~µs cost
 
     t0 = time.perf_counter()
     for _ in range(args.reps):
         out, _ = gen(params, ids, mask, rng)
-    jax.block_until_ready(out)
+        np.asarray(out)
     total = (time.perf_counter() - t0) / args.reps
 
     # short-prompt baseline (128 tokens, or 1/4 of the tiny CPU prompt): same decode length,
@@ -96,11 +98,11 @@ def main() -> None:
     gen1 = make_generate_fn(model, max_new_tokens=args.new, do_sample=False)
     ids1, mask1 = ids[:, :short_len], mask[:, :short_len]
     out, _ = gen1(params, ids1, mask1, rng)
-    jax.block_until_ready(out)
+    np.asarray(out)
     t0 = time.perf_counter()
     for _ in range(args.reps):
         out, _ = gen1(params, ids1, mask1, rng)
-    jax.block_until_ready(out)
+        np.asarray(out)
     short = (time.perf_counter() - t0) / args.reps
 
     decode_tok_s = args.batch * args.new / short  # decode-dominated (incl. short prefill)
