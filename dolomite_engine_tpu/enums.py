@@ -60,12 +60,16 @@ class AttentionImplementation(Enum):
         causal attention on sequence-sharded activations with ppermute'd K/V blocks; falls
         back to sdpa when the mesh has no sp sharding. Absent in the reference (SURVEY §2.6
         lists CP as not implemented) — TPU-native extension.
+      - ``ulysses``: all_to_all context parallelism over "sp" — reshard seq->heads, run the
+        full-sequence Pallas kernel locally, reshard back. Needs sp | (n_head/tp); same
+        fallback rules as ``ring``. TPU-native extension.
     """
 
     eager = "eager"
     sdpa = "sdpa"
     flash_attention_2 = "flash_attention_2"
     ring = "ring"
+    ulysses = "ulysses"
 
 
 class DistributedBackend(Enum):

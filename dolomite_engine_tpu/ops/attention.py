@@ -267,24 +267,35 @@ def attention(
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
 
-    if implementation == AttentionImplementation.ring:
+    if implementation in (AttentionImplementation.ring, AttentionImplementation.ulysses):
         from ..parallel.mesh import MeshManager
         from .ring_attention import ring_attention_sharded
+        from .ulysses_attention import ulysses_attention_sharded
 
-        use_ring = (
-            MeshManager.is_initialized()
-            and MeshManager.axis_size("sp") > 1
-            and q.shape[1] == k.shape[1]  # no decode-with-cache over the ring
-            and q.shape[1] % MeshManager.axis_size("sp") == 0
+        cp_name = implementation.value
+        sp = MeshManager.axis_size("sp") if MeshManager.is_initialized() else 1
+        tp = MeshManager.axis_size("tp") if MeshManager.is_initialized() else 1
+        use_cp = (
+            sp > 1
+            and q.shape[1] == k.shape[1]  # no decode-with-cache over CP
+            and q.shape[1] % sp == 0
             and attention_mask is None  # padded batches: use packed segment_ids instead
             and alibi_bias is None
             and dropout == 0.0
             and causal
         )
-        if use_ring:
-            # K/V stay un-repeated: GQA grouping happens inside the ring so ICI moves only
-            # kv heads
-            return ring_attention_sharded(
+        if implementation == AttentionImplementation.ulysses:
+            # the head all_to_all needs an even split of each tp shard's local heads
+            use_cp = use_cp and q.shape[2] % tp == 0 and (q.shape[2] // tp) % sp == 0
+        if use_cp:
+            cp_fn = (
+                ring_attention_sharded
+                if implementation == AttentionImplementation.ring
+                else ulysses_attention_sharded
+            )
+            # K/V stay un-repeated: GQA grouping happens inside the CP body so ICI moves
+            # only kv heads (ring) / the minimal grouped repeat (ulysses)
+            return cp_fn(
                 q,
                 k,
                 v,
@@ -293,8 +304,8 @@ def attention(
                 softmax_scale=softmax_scale,
                 segment_ids=segment_ids,
             )
-        if MeshManager.is_initialized() and MeshManager.axis_size("sp") > 1:
-            # the mesh HAS sequence sharding but this call can't ride the ring — say so
+        if sp > 1:
+            # the mesh HAS sequence sharding but this call can't ride CP — say so
             # once per trace so the user knows the CP savings aren't happening here
             import logging
 
@@ -302,9 +313,11 @@ def attention(
 
             log_rank_0(
                 logging.WARNING,
-                "ring attention fell back to sdpa (requires: no kv cache, no attention_mask "
-                "— use packed segment_ids, no alibi, no dropout, causal, seq divisible by "
-                f"sp={MeshManager.axis_size('sp')})",
+                f"{cp_name} attention fell back to sdpa (requires: no kv cache, no "
+                "attention_mask — use packed segment_ids, no alibi, no dropout, causal, "
+                f"seq divisible by sp={sp}"
+                + (", sp | n_head/tp" if implementation == AttentionImplementation.ulysses else "")
+                + ")",
             )
         implementation = AttentionImplementation.sdpa
 

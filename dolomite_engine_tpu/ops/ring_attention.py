@@ -29,7 +29,10 @@ def _block_attend(q, k, v, bias_mask, softmax_scale, o, m, l):
     k,v [B,Sk,Hkv,D]; bias_mask [B,1,1,Sq,Sk] bool (True = attend);
     o [B,Sq,Hkv,G,D] f32 accumulator; m, l [B,Hkv,G,Sq] running max / sum.
     """
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * softmax_scale
+    scores = (
+        jnp.einsum("bqhgd,bkhd->bhgqk", q, k, preferred_element_type=jnp.float32)
+        * softmax_scale
+    )
     scores = jnp.where(bias_mask, scores, _NEG_INF)
 
     m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
@@ -40,7 +43,7 @@ def _block_attend(q, k, v, bias_mask, softmax_scale, o, m, l):
 
     l_new = l * correction + jnp.sum(p, axis=-1)
     o_new = o * jnp.moveaxis(correction, 3, 1)[..., None] + jnp.einsum(
-        "bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32)
+        "bhgqk,bkhd->bqhgd", p, v, preferred_element_type=jnp.float32
     )
     return o_new, m_new, l_new
 
@@ -53,6 +56,7 @@ def ring_attention(
     causal: bool = True,
     softmax_scale: float | None = None,
     segment_ids_q: jax.Array | None = None,
+    query_chunk_size: int | None = None,
 ) -> jax.Array:
     """Exact attention over sequence blocks distributed on `axis_name` (call under shard_map).
 
@@ -60,6 +64,12 @@ def ring_attention(
     UN-repeated, so each ring hop moves Hkv (not Hq) heads over ICI; the group dimension is
     handled by grouped einsums locally. segment_ids_q: local [B, S_loc] document ids
     (0 = padding) for packed sequences. Returns the local output block [B, S_loc, Hq, D].
+
+    query_chunk_size bounds each hop's score buffer at [B,Hkv,G,chunk,S_loc] f32 by scanning
+    Q chunks sequentially (flash-style, with rematerialized backward) — without it the hop
+    materializes [B,Hkv,G,S_loc,S_loc], which at the long contexts CP exists for is the
+    dominant allocation. Default: auto-chunk at 1024 once S_loc > 2048 (chunking smaller
+    blocks just adds scan overhead); chunking requires chunk | S_loc, else it is skipped.
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
@@ -70,6 +80,10 @@ def ring_attention(
     num_kv = k.shape[2]
     group = num_heads // num_kv
     q = q.reshape(batch, s_loc, num_kv, group, dim)
+
+    if query_chunk_size is None and s_loc > 2048:
+        query_chunk_size = 1024
+    chunk = query_chunk_size if query_chunk_size and s_loc % query_chunk_size == 0 else None
 
     # accumulators must be device-varying to be a legal loop value under shard_map; deriving
     # the zeros from q inherits its varying axes without naming them explicitly
@@ -83,21 +97,52 @@ def ring_attention(
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     k_blk, v_blk, seg_blk = k, v, segment_ids_q
 
+    def hop(q, o, m, l, q_pos, seg_q, k_blk, v_blk, seg_blk, k_pos):
+        """Attend one (possibly chunked) query slab against the K/V block held this step."""
+        mask = jnp.ones((1, 1, 1, q.shape[1], s_loc), bool)
+        if causal:
+            mask = mask & (k_pos[None, None, None, None, :] <= q_pos[None, None, None, :, None])
+        if seg_blk is not None:
+            same = seg_q[:, None, None, :, None] == seg_blk[:, None, None, None, :]
+            nonpad = (seg_blk != 0)[:, None, None, None, :]
+            mask = mask & same & nonpad
+        return _block_attend(q, k_blk, v_blk, mask, softmax_scale, o, m, l)
+
     # static unroll over the (small) ring; the last step skips the rotate whose result
     # nobody consumes, saving one full K/V block transfer per call
     for step_idx in range(axis_size):
         src = (my_index - step_idx) % axis_size  # whose block we hold this step
         k_pos = src * s_loc + jnp.arange(s_loc)
 
-        mask = jnp.ones((batch, 1, 1, s_loc, s_loc), bool)
-        if causal:
-            mask = mask & (k_pos[None, None, None, None, :] <= q_pos[None, None, None, :, None])
-        if seg_blk is not None:
-            same = segment_ids_q[:, None, None, :, None] == seg_blk[:, None, None, None, :]
-            nonpad = (seg_blk != 0)[:, None, None, None, :]
-            mask = mask & same & nonpad
+        if chunk is None:
+            o, m, l = hop(q, o, m, l, q_pos, segment_ids_q, k_blk, v_blk, seg_blk, k_pos)
+        else:
+            n_chunks = s_loc // chunk
 
-        o, m, l = _block_attend(q, k_blk, v_blk, mask, softmax_scale, o, m, l)
+            def to_chunks_seq(x):  # [B, S, ...] -> [C, B, chunk, ...]
+                return jnp.moveaxis(x.reshape((batch, n_chunks, chunk) + x.shape[2:]), 1, 0)
+
+            def to_chunks_ml(x):  # [B, Hkv, G, S] -> [C, B, Hkv, G, chunk]
+                return jnp.moveaxis(x.reshape(batch, num_kv, group, n_chunks, chunk), 3, 0)
+
+            xs = (
+                to_chunks_seq(q),
+                to_chunks_seq(o),
+                to_chunks_ml(m),
+                to_chunks_ml(l),
+                q_pos.reshape(n_chunks, chunk),
+                None if segment_ids_q is None else to_chunks_seq(segment_ids_q),
+            )
+
+            @jax.checkpoint
+            def chunk_body(args):
+                q_c, o_c, m_c, l_c, qpos_c, segq_c = args
+                return hop(q_c, o_c, m_c, l_c, qpos_c, segq_c, k_blk, v_blk, seg_blk, k_pos)
+
+            o_c, m_c, l_c = jax.lax.map(chunk_body, xs)
+            o = jnp.moveaxis(o_c, 0, 1).reshape(batch, s_loc, num_kv, group, dim)
+            m = jnp.moveaxis(m_c, 0, 3).reshape(batch, num_kv, group, s_loc)
+            l = jnp.moveaxis(l_c, 0, 3).reshape(batch, num_kv, group, s_loc)
 
         if step_idx < axis_size - 1:
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -124,6 +169,7 @@ def ring_attention_sharded(
     # over "ep" at every attention call when sp>1 and ep>1 compose
     batch_axes: tuple[str, ...] = ("dp", "fsdp", "ep"),
     head_axis: str = "tp",
+    query_chunk_size: int | None = None,
 ) -> jax.Array:
     """GSPMD-callable wrapper: shard_map `ring_attention` with batch over `batch_axes`,
     sequence over `seq_axis`, heads over `head_axis` (TP composes: each tp device rings only
@@ -149,6 +195,7 @@ def ring_attention_sharded(
         return ring_attention(
             q, k, v, seq_axis, causal, softmax_scale,
             segment_ids_q=seg[0] if seg else None,
+            query_chunk_size=query_chunk_size,
         )
 
     return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec)(*operands)

@@ -231,6 +231,12 @@ def get_model_tflops(
     mlp_flops = 4 * b * s * h * f
     if is_glu(config.activation_function):
         mlp_flops += 2 * b * s * h * f
+    # MoE: each token runs num_experts_per_tok expert MLPs (the reference formula predates
+    # its MoE models and counts a single dense MLP; this keeps dense configs bit-identical
+    # and makes MoE MFU honest — router FLOPs (bshE) are negligible and left out)
+    active_experts = getattr(config, "num_experts_per_tok", None)
+    if active_experts:
+        mlp_flops *= active_experts
 
     forward = l * (attention_flops + mlp_flops)
     backward = 2 * forward

@@ -1,0 +1,25 @@
+"""Shared fixtures for the parallelism test suites (ring/ulysses CP, sharding, EP)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+
+@pytest.fixture()
+def mesh_sp4(eight_devices):
+    MeshManager(sequence_parallel_size=4, data_parallel_sharding_world_size=2)
+    yield MeshManager.get_mesh()
+    MeshManager.destroy()
+
+
+def make_qkv(B=4, S=32, Hq=2, Hkv=None, D=8, seed=0):
+    """Random fp32 (q, k, v) with [B, S, H, D] layout; Hkv defaults to Hq (MHA)."""
+    Hkv = Hq if Hkv is None else Hkv
+    rs = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rs.randn(B, S, Hq, D).astype(np.float32)),
+        jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32)),
+        jnp.asarray(rs.randn(B, S, Hkv, D).astype(np.float32)),
+    )

@@ -5,10 +5,11 @@ single-device attention, including packed segment masking, and an end-to-end sha
 step with the sequence axis active.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dolomite_engine_tpu.enums import AttentionImplementation
 from dolomite_engine_tpu.ops.attention import make_attention_mask, sdpa_attention
@@ -16,22 +17,9 @@ from dolomite_engine_tpu.ops.ring_attention import ring_attention_sharded
 from dolomite_engine_tpu.parallel.mesh import MeshManager
 
 from ..test_commons import assert_allclose
+from .conftest import make_qkv
 
-
-@pytest.fixture()
-def mesh_sp4(eight_devices):
-    MeshManager(sequence_parallel_size=4, data_parallel_sharding_world_size=2)
-    yield MeshManager.get_mesh()
-    MeshManager.destroy()
-
-
-def _qkv(B=4, S=32, H=2, D=8, seed=0):
-    rs = np.random.RandomState(seed)
-    return (
-        jnp.asarray(rs.randn(B, S, H, D).astype(np.float32)),
-        jnp.asarray(rs.randn(B, S, H, D).astype(np.float32)),
-        jnp.asarray(rs.randn(B, S, H, D).astype(np.float32)),
-    )
+_qkv = functools.partial(make_qkv, Hq=2)  # mesh_sp4 fixture comes from ./conftest.py
 
 
 def test_ring_matches_sdpa_causal(mesh_sp4):
@@ -160,3 +148,41 @@ def test_sharded_train_step_with_ring(mesh_sp4):
         state, metrics = jax.jit(step_fn, donate_argnums=0)(state, batch, jax.random.PRNGKey(1))
         loss = float(metrics["loss"])
     assert np.isfinite(loss)
+
+
+def test_ring_query_chunking_exact(mesh_sp4):
+    """query_chunk_size changes memory layout only: chunked == unchunked == sdpa, for the
+    forward AND the gradient, including packed segments (S_loc = 32/4 = 8, chunk 4 -> 2
+    chunks per hop)."""
+    q, k, v = _qkv(seed=2)
+    seg = jnp.asarray(np.repeat([[1] * 18 + [2] * 10 + [0] * 4], 4, axis=0))
+
+    def run(chunk):
+        def f(q, k, v):
+            out = ring_attention_sharded(
+                q, k, v, mesh_sp4, causal=True, segment_ids=seg,
+                batch_axes=("dp", "fsdp"), query_chunk_size=chunk,
+            )
+            return (out * jnp.where(seg != 0, 1.0, 0.0)[..., None, None]).sum()
+
+        with mesh_sp4:
+            val, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return val, grads
+
+    val_ref, g_ref = run(None)
+    val_c, g_c = run(4)
+    assert_allclose(val_c, val_ref, atol=2e-5, rtol=2e-5)
+    for a, b in zip(g_c, g_ref):
+        assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    # sdpa cross-check of the chunked forward
+    ref = sdpa_attention(
+        q, k, v, make_attention_mask(4, 32, 32, causal=True, segment_ids_q=seg), None, 8**-0.5
+    )
+    with mesh_sp4:
+        out = ring_attention_sharded(
+            q, k, v, mesh_sp4, causal=True, segment_ids=seg,
+            batch_axes=("dp", "fsdp"), query_chunk_size=4,
+        )
+    valid = np.asarray(seg) != 0
+    assert_allclose(np.asarray(out)[valid], np.asarray(ref)[valid], atol=2e-5, rtol=2e-5)

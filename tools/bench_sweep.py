@@ -41,6 +41,8 @@ def main() -> None:
     p.add_argument("--profile", type=str, default=None, help="jax.profiler trace dir")
     p.add_argument("--splash", action="store_true", help="use the splash attention kernel")
     p.add_argument("--packed", action="store_true", help="packed segment-ids path (reset_attention_mask)")
+    p.add_argument("--moe", type=int, default=0, help="num_experts (0 = dense gpt_dolomite)")
+    p.add_argument("--top_k", type=int, default=2, help="experts per token (with --moe)")
     args = p.parse_args()
 
     if args.splash:
@@ -76,6 +78,13 @@ def main() -> None:
         fused_lm_head_loss=args.fused_loss,
         loss_chunk_size=args.loss_chunk,
     )
+    if args.moe:
+        config.update(
+            model_type="moe_dolomite",
+            num_experts=args.moe,
+            num_experts_per_tok=args.top_k,
+            router_aux_loss_coef=0.01,
+        )
 
     MeshManager()
     mesh = MeshManager.get_mesh()
