@@ -7,8 +7,14 @@ for pretraining. vs_baseline therefore reports achieved MFU / 0.40.
 """
 
 import json
+import os
 import sys
 import time
+
+# GQA-native splash attention: measured 0.408 MFU vs 0.358 legacy-flash on the identical
+# accum-16 run (PROFILE.md step 3b A/B); numerics pinned by the interpret-mode parity tests
+# in tests/ops/test_attention_dispatch.py. Must be set before the first trace.
+os.environ.setdefault("DOLOMITE_SPLASH_ATTENTION", "1")
 
 import jax
 import jax.numpy as jnp

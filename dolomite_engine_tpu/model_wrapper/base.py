@@ -89,11 +89,16 @@ class ModelWrapper:
         self._setup_tokenizer(tokenizer_name, additional_special_tokens)
 
         checkpoint_every = 0
+        checkpoint_policy = None
         if gradient_checkpointing_args:
             checkpoint_every = gradient_checkpointing_args.get(
                 "checkpoint_every", gradient_checkpointing_args.get("block_frequency", 1)
             )
+            # jax.checkpoint_policies name, e.g. dots_saveable (TPU extension: block-granular
+            # torch checkpointing can't express save-matmuls-recompute-elementwise)
+            checkpoint_policy = gradient_checkpointing_args.get("checkpoint_policy")
         self.checkpoint_every = checkpoint_every
+        self.checkpoint_policy = checkpoint_policy
 
         self._setup_model()
 
@@ -186,6 +191,7 @@ class ModelWrapper:
             attention_implementation=self.attention_implementation,
             dtype=self.dtype,
             checkpoint_every=self.checkpoint_every,
+            checkpoint_policy=self.checkpoint_policy,
             **self.model_kwargs,
         )
 

@@ -44,11 +44,31 @@ class CausalLMOutput:
     aux_loss: jax.Array | None = None
 
 
+def resolve_remat_policy(name: str | None):
+    """Map a `gradient_checkpointing_args.checkpoint_policy` name to a jax policy fn.
+
+    Names are `jax.checkpoint_policies` attributes (e.g. ``dots_saveable`` keeps matmul
+    outputs and recomputes only elementwise ops — the middle ground between full block
+    remat and no remat that block-granular torch checkpointing can't express). None keeps
+    jax's default (save nothing)."""
+    if name is None:
+        return None
+    policy = getattr(jax.checkpoint_policies, name, None)
+    if policy is None or not callable(policy):
+        valid = sorted(
+            n for n in dir(jax.checkpoint_policies)
+            if not n.startswith("_") and callable(getattr(jax.checkpoint_policies, n))
+        )
+        raise ValueError(f"unknown checkpoint_policy '{name}' (expected one of {valid})")
+    return policy
+
+
 class GPTDolomiteModel(nn.Module):
     config: CommonConfig
     attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
     dtype: Any = jnp.float32
     checkpoint_every: int = 0  # 0 = no remat; k = remat every k-th block
+    checkpoint_policy: str | None = None  # jax.checkpoint_policies name (see resolve_remat_policy)
     block_cls: type = Block
 
     def setup(self) -> None:
@@ -71,11 +91,12 @@ class GPTDolomiteModel(nn.Module):
         self.drop = nn.Dropout(rate=config.embd_pdrop)
 
         blocks = []
+        remat_policy = resolve_remat_policy(self.checkpoint_policy)
         for i in range(self.num_blocks):
             cls = self.block_cls
             if self.checkpoint_every and i % self.checkpoint_every == 0:
                 # flax counts the module instance as argument 0; deterministic is arg 8
-                cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False)
+                cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy)
             blocks.append(self._make_block(cls, i))
         self.h = blocks
 
@@ -180,6 +201,7 @@ class GPTDolomiteForCausalLM(nn.Module):
     attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
     dtype: Any = jnp.float32
     checkpoint_every: int = 0
+    checkpoint_policy: str | None = None
     base_model_cls: type = GPTDolomiteModel
 
     def _transformer_kwargs(self) -> dict:
@@ -189,6 +211,7 @@ class GPTDolomiteForCausalLM(nn.Module):
             attention_implementation=self.attention_implementation,
             dtype=self.dtype,
             checkpoint_every=self.checkpoint_every,
+            checkpoint_policy=self.checkpoint_policy,
         )
 
     def setup(self) -> None:

@@ -81,9 +81,20 @@ def ring_attention(
     group = num_heads // num_kv
     q = q.reshape(batch, s_loc, num_kv, group, dim)
 
-    if query_chunk_size is None and s_loc > 2048:
+    auto = query_chunk_size is None
+    if auto and s_loc > 2048:
         query_chunk_size = 1024
-    chunk = query_chunk_size if query_chunk_size and s_loc % query_chunk_size == 0 else None
+    chunk = None
+    if query_chunk_size:
+        # honor the bound for ANY S_loc: largest divisor <= the requested size (not just an
+        # exact divide — seq 40960 / sp 16 gives S_loc 2560, where 1024 doesn't divide but
+        # 512 does). The auto path gives up below 256 (near-prime S_loc), where scan
+        # overhead would dominate the memory win; an explicit request is honored down to 1.
+        floor = 255 if auto else 0
+        chunk = next(
+            (c for c in range(min(query_chunk_size, s_loc), floor, -1) if s_loc % c == 0),
+            None,
+        )
 
     # accumulators must be device-varying to be a legal loop value under shard_map; deriving
     # the zeros from q inherits its varying axes without naming them explicitly

@@ -231,10 +231,14 @@ def get_model_tflops(
     mlp_flops = 4 * b * s * h * f
     if is_glu(config.activation_function):
         mlp_flops += 2 * b * s * h * f
-    # MoE: each token runs num_experts_per_tok expert MLPs (the reference formula predates
-    # its MoE models and counts a single dense MLP; this keeps dense configs bit-identical
-    # and makes MoE MFU honest — router FLOPs (bshE) are negligible and left out)
+    # MoE: each token runs num_experts_per_tok expert MLPs; DenseMoE runs ONE wide MLP of
+    # num_experts * n_inner for every token (models/dense_moe.py:74). The reference formula
+    # predates its MoE models and counts a single n_inner MLP; this keeps dense configs
+    # bit-identical and makes MoE MFU honest — router FLOPs (bshE) are negligible and
+    # left out.
     active_experts = getattr(config, "num_experts_per_tok", None)
+    if getattr(config, "model_type", None) == "dense_moe":
+        active_experts = config.num_experts
     if active_experts:
         mlp_flops *= active_experts
 

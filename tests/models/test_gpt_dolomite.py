@@ -139,3 +139,36 @@ def test_tied_and_untied_lm_head():
     assert "lm_head" not in p1["params"]
     assert "lm_head" in p2["params"]
     assert m2.apply(p2, ids).logits.shape == m1.apply(p1, ids).logits.shape
+
+
+def test_checkpoint_policy_remat_is_numerics_identical():
+    """gradient_checkpointing_args.checkpoint_policy maps to jax.checkpoint_policies and
+    changes rematerialization only: loss AND grads are bit-identical to no-remat; unknown
+    names fail loudly with the valid list."""
+    config = get_dense_test_config("mqa", "rope")
+    ids, _ = get_dummy_inputs(config, padded=False)
+
+    results = {}
+    for name, kwargs in [
+        ("none", {}),
+        ("block", dict(checkpoint_every=1)),
+        ("dots", dict(checkpoint_every=1, checkpoint_policy="dots_saveable")),
+    ]:
+        model = GPTDolomiteForCausalLM(config=config, **kwargs)
+        params = model.init(jax.random.PRNGKey(0), ids)
+
+        def loss_fn(p):
+            return model.apply(p, ids, labels=ids, compute_loss=True).loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        flat = jax.flatten_util.ravel_pytree(grads)[0]
+        results[name] = (float(loss), np.asarray(flat))
+
+    for name in ("block", "dots"):
+        assert results[name][0] == results["none"][0]
+        np.testing.assert_array_equal(results[name][1], results["none"][1])
+
+    with pytest.raises(ValueError, match="unknown checkpoint_policy"):
+        GPTDolomiteForCausalLM(
+            config=config, checkpoint_every=1, checkpoint_policy="nope"
+        ).init(jax.random.PRNGKey(0), ids)

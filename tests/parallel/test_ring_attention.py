@@ -186,3 +186,15 @@ def test_ring_query_chunking_exact(mesh_sp4):
         )
     valid = np.asarray(seg) != 0
     assert_allclose(np.asarray(out)[valid], np.asarray(ref)[valid], atol=2e-5, rtol=2e-5)
+
+
+def test_ring_auto_chunk_long_block(mesh_sp4):
+    """S_loc = 12288/4 = 3072 > 2048 trips the automatic 1024-query chunking; spot-check a
+    slice against sdpa (full-S reference is cheap at H=1, D=4)."""
+    q, k, v = make_qkv(B=1, S=12288, Hq=1, D=4, seed=5)
+    ref = sdpa_attention(
+        q, k, v, make_attention_mask(1, 12288, 12288, causal=True), None, 4**-0.5
+    )
+    with mesh_sp4:
+        out = ring_attention_sharded(q, k, v, mesh_sp4, causal=True, batch_axes=("dp", "fsdp"))
+    assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

@@ -89,35 +89,3 @@ def get_dummy_inputs(config, batch: int = 2, seq: int = 16, padded: bool = True)
 def assert_allclose(a, b, atol=1e-5, rtol=1e-5, msg=""):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol, rtol=rtol, err_msg=msg)
 
-
-def test_get_model_tflops_moe_counts_active_experts():
-    """MFU honesty: a MoE config counts num_experts_per_tok expert MLPs per token; the
-    attention/lm_head terms and dense configs are bit-identical to the reference formula
-    (reference train_utils.py:197-236, which predates its MoE models)."""
-    from dolomite_engine_tpu.train_utils import get_model_tflops
-
-    common = dict(
-        vocab_size=1024,
-        n_positions=128,
-        n_embd=256,
-        n_layer=2,
-        n_head=4,
-        num_key_value_heads=4,
-        attention_head_type="mha",
-        activation_function="swiglu",
-    )
-    dense = CommonConfig(**common)
-    moe = MoEConfig(**common, num_experts=8, num_experts_per_tok=2)
-
-    b, s = 4, 128
-    t_dense = get_model_tflops(dense, b, s)
-    t_moe = get_model_tflops(moe, b, s)
-
-    # hand-computed dense pieces
-    h, f, n, k, v, l = 256, dense.n_inner, 4, 4, 1024, 2
-    attn = 4 * b * s * h * (h * (1 + k / n) + s)
-    mlp = 6 * b * s * h * f  # 4 + 2 (GLU)
-    lm_head = 6 * b * s * h * v
-    assert t_dense == (3 * l * (attn + mlp) + lm_head) / 1e12
-    # MoE: only the MLP term scales by the active expert count
-    assert t_moe == (3 * l * (attn + 2 * mlp) + lm_head) / 1e12

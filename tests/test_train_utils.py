@@ -1,0 +1,67 @@
+"""Analytic TFLOPs/MFU accounting (train_utils.get_model_tflops).
+
+Reference formula: train_utils.py:197-236 — attn = 4bsh(h(1+k/n)+s), mlp = 4bshf (+2bshf
+GLU), lm_head = 6bshv, bwd = 2x fwd, +1x fwd per checkpointed block. The reference predates
+its MoE models and always counts one dense MLP; here the MoE families count their real
+MLP FLOPs (dense configs stay bit-identical)."""
+
+from dolomite_engine_tpu.models.config import CommonConfig, DenseMoEConfig, MoEConfig
+from dolomite_engine_tpu.train_utils import get_model_tflops
+
+_COMMON = dict(
+    vocab_size=1024,
+    n_positions=128,
+    n_embd=256,
+    n_layer=2,
+    n_head=4,
+    num_key_value_heads=4,
+    attention_head_type="mha",
+    activation_function="swiglu",
+)
+
+
+def _pieces(b, s, config):
+    h, f, n, k, v, l = (
+        config.n_embd, config.n_inner, config.n_head,
+        config.num_key_value_heads, config.vocab_size, config.n_layer,
+    )
+    attn = 4 * b * s * h * (h * (1 + k / n) + s)
+    mlp = 6 * b * s * h * f  # 4 + 2 (GLU)
+    lm_head = 6 * b * s * h * v
+    return attn, mlp, lm_head, l
+
+
+def test_dense_matches_reference_formula():
+    config = CommonConfig(**_COMMON)
+    b, s = 4, 128
+    attn, mlp, lm_head, l = _pieces(b, s, config)
+    assert get_model_tflops(config, b, s) == (3 * l * (attn + mlp) + lm_head) / 1e12
+
+
+def test_moe_counts_active_experts():
+    """moe_dolomite: num_experts_per_tok expert MLPs per token."""
+    config = MoEConfig(**_COMMON, num_experts=8, num_experts_per_tok=2)
+    b, s = 4, 128
+    attn, mlp, lm_head, l = _pieces(b, s, config)
+    assert get_model_tflops(config, b, s) == (3 * l * (attn + 2 * mlp) + lm_head) / 1e12
+
+
+def test_dense_moe_counts_wide_mlp():
+    """dense_moe runs ONE wide MLP of num_experts * n_inner for every token
+    (models/dense_moe.py:74) -> mlp term scales by num_experts."""
+    config = DenseMoEConfig(**_COMMON, num_experts=4)
+    b, s = 4, 128
+    attn, mlp, lm_head, l = _pieces(b, s, config)
+    assert get_model_tflops(config, b, s) == (3 * l * (attn + 4 * mlp) + lm_head) / 1e12
+
+
+def test_checkpointing_adds_recompute_fraction():
+    config = CommonConfig(**_COMMON)
+    b, s = 4, 128
+    attn, mlp, lm_head, l = _pieces(b, s, config)
+    got = get_model_tflops(
+        config, b, s, gradient_checkpointing_method="block",
+        gradient_checkpointing_args={"checkpoint_every": 2},
+    )
+    fwd = l * (attn + mlp)
+    assert got == (3 * fwd + 0.5 * fwd + lm_head) / 1e12

@@ -31,6 +31,8 @@ def main() -> None:
     p.add_argument("--micro_bs", type=int, default=8)
     p.add_argument("--accum", type=int, default=1)
     p.add_argument("--ckpt", type=int, default=0, help="checkpoint_every (0 = no remat)")
+    p.add_argument("--ckpt_policy", type=str, default=None,
+                   help="jax.checkpoint_policies name (e.g. dots_saveable), with --ckpt")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--vocab", type=int, default=50304)
     p.add_argument("--mu_dtype", type=str, default=None, help="optax adamw mu dtype override")
@@ -90,6 +92,8 @@ def main() -> None:
     mesh = MeshManager.get_mesh()
 
     gc_args = {"checkpoint_every": args.ckpt} if args.ckpt else None
+    if gc_args and args.ckpt_policy:
+        gc_args["checkpoint_policy"] = args.ckpt_policy
     wrapper = ModelWrapperForPretraining(
         mode=Mode.training,
         pretrained_config=config,

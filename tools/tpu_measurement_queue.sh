@@ -1,25 +1,38 @@
 #!/bin/bash
-# Queued on-chip measurements from round 3 (the axon tunnel died mid-round — PROFILE.md
-# step 4). Run this first thing when a chip is reachable; each line is one A/B from the
-# PROFILE.md pending list. Waits (up to ~7h) for the chip, then measures.
+# Pending on-chip measurements (round 3, updated after the splash/packed/driver-config
+# results landed — PROFILE.md step 3b). The axon lease wedged again mid-round (step 4);
+# run this when a chip is reachable. Order matters: OOM-risky runs LAST — an OOM'd remote
+# compile can wedge the lease for every following run.
 cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
     echo "=== TPU recovered at $(date)"
-    echo "=== accum16 confirm"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --steps 5 2>&1 | tail -1
-    echo "=== splash kernel A/B"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
-    echo "=== 2048x12 mu_bf16"
-    timeout 900 python tools/bench_sweep.py --n_embd 2048 --n_layer 12 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --mu_dtype bfloat16 --steps 5 2>&1 | tail -1
-    echo "=== fp8 variant"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 8 --fused_loss --dtype fp8 --steps 5 2>&1 | tail -1
-    echo "=== packed segment-ids variant"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --packed --steps 5 2>&1 | tail -1
-    echo "=== generation bench"
-    timeout 900 python tools/bench_generation.py 2>&1 | tail -1
-    echo "=== bench.py (driver config)"
+    echo "=== bench.py driver config (splash now default)"
     timeout 1200 python bench.py 2>&1 | tail -1
+    echo "=== splash+packed accum16"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --packed --steps 5 2>&1 | tail -1
+    echo "=== splash accum32"
+    timeout 1200 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 32 --fused_loss --splash --steps 3 2>&1 | tail -1
+    echo "=== latency-hiding scheduler A/B (splash accum16)"
+    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
+    echo "=== loss_chunk 512 A/B (splash accum16)"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --loss_chunk 512 --splash --steps 5 2>&1 | tail -1
+    echo "=== head_dim 128 A/B: 1024x24 n_head 8 kv 4, splash accum16"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --n_head 8 --kv_heads 4 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
+    echo "=== MoE 8x top2 (scatter ragged_dot, splash)"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 12 --micro_bs 8 --accum 8 --fused_loss --splash --moe 8 --top_k 2 --steps 5 2>&1 | tail -1
+    echo "=== long context seq 8192 (splash, ckpt 1)"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 2 --accum 8 --seq 8192 --fused_loss --splash --ckpt 1 --steps 3 2>&1 | tail -1
+    echo "=== generation bench (host-fetch timing)"
+    timeout 900 python tools/bench_generation.py 2>&1 | tail -1
+    echo "=== bf16 control mb4 accum8 (for the fp8 delta)"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --steps 5 2>&1 | tail -1
+    echo "=== fp8 mb4 accum8 (OOM risk from here down)"
+    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --dtype fp8 --steps 5 2>&1 | tail -3
+    echo "=== 1536x16 n_head 12 kv 6 splash mu_bf16 accum8"
+    timeout 900 python tools/bench_sweep.py --n_embd 1536 --n_layer 16 --n_head 12 --kv_heads 6 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --steps 5 2>&1 | tail -1
+    echo "=== 2048x12 n_head 16 kv 8 splash mu_bf16 ckpt1+dots accum8"
+    timeout 900 python tools/bench_sweep.py --n_embd 2048 --n_layer 12 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --ckpt_policy dots_saveable --steps 5 2>&1 | tail -1
     echo "=== done at $(date)"
     exit 0
   fi
