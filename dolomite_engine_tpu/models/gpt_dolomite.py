@@ -44,6 +44,19 @@ class CausalLMOutput:
     aux_loss: jax.Array | None = None
 
 
+# the zero-arg members of jax.checkpoint_policies that ARE policies; the rest are policy
+# FACTORIES (save_only_these_names(*names), ...) whose direct use as a policy would silently
+# mark everything saveable instead of erroring
+_REMAT_POLICIES = (
+    "checkpoint_dots",
+    "checkpoint_dots_with_no_batch_dims",
+    "dots_saveable",
+    "dots_with_no_batch_dims_saveable",
+    "everything_saveable",
+    "nothing_saveable",
+)
+
+
 def resolve_remat_policy(name: str | None):
     """Map a `gradient_checkpointing_args.checkpoint_policy` name to a jax policy fn.
 
@@ -53,14 +66,9 @@ def resolve_remat_policy(name: str | None):
     jax's default (save nothing)."""
     if name is None:
         return None
-    policy = getattr(jax.checkpoint_policies, name, None)
-    if policy is None or not callable(policy):
-        valid = sorted(
-            n for n in dir(jax.checkpoint_policies)
-            if not n.startswith("_") and callable(getattr(jax.checkpoint_policies, n))
-        )
-        raise ValueError(f"unknown checkpoint_policy '{name}' (expected one of {valid})")
-    return policy
+    if name not in _REMAT_POLICIES:
+        raise ValueError(f"unknown checkpoint_policy '{name}' (expected one of {_REMAT_POLICIES})")
+    return getattr(jax.checkpoint_policies, name)
 
 
 class GPTDolomiteModel(nn.Module):

@@ -69,7 +69,9 @@ def ring_attention(
     Q chunks sequentially (flash-style, with rematerialized backward) — without it the hop
     materializes [B,Hkv,G,S_loc,S_loc], which at the long contexts CP exists for is the
     dominant allocation. Default: auto-chunk at 1024 once S_loc > 2048 (chunking smaller
-    blocks just adds scan overhead); chunking requires chunk | S_loc, else it is skipped.
+    blocks just adds scan overhead). The actual chunk is the largest divisor of S_loc <=
+    the requested size; if that falls below request/4 (near-prime S_loc), chunking is
+    skipped rather than degrading to a per-query scan.
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
@@ -81,18 +83,17 @@ def ring_attention(
     group = num_heads // num_kv
     q = q.reshape(batch, s_loc, num_kv, group, dim)
 
-    auto = query_chunk_size is None
-    if auto and s_loc > 2048:
+    if query_chunk_size is None and s_loc > 2048:
         query_chunk_size = 1024
     chunk = None
     if query_chunk_size:
         # honor the bound for ANY S_loc: largest divisor <= the requested size (not just an
         # exact divide — seq 40960 / sp 16 gives S_loc 2560, where 1024 doesn't divide but
-        # 512 does). The auto path gives up below 256 (near-prime S_loc), where scan
-        # overhead would dominate the memory win; an explicit request is honored down to 1.
-        floor = 255 if auto else 0
+        # 512 does). Chunks below request/4 are refused (near-prime S_loc would otherwise
+        # degrade toward chunk=1, an S_loc-iteration scan) — chunking is skipped instead.
+        floor = max(1, query_chunk_size // 4)
         chunk = next(
-            (c for c in range(min(query_chunk_size, s_loc), floor, -1) if s_loc % c == 0),
+            (c for c in range(min(query_chunk_size, s_loc), floor - 1, -1) if s_loc % c == 0),
             None,
         )
 

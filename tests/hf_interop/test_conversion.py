@@ -310,3 +310,48 @@ def test_import_bin_only_checkpoint(tmp_path):
         mgr.get_tensor("transformer.wte.weight"),
         ref_sd["model.embed_tokens.weight"].numpy(),
     )
+
+
+def test_import_no_weight_files_raises_cleanly(tmp_path):
+    """A checkpoint dir with a config but neither *.safetensors nor pytorch_model*.bin
+    (e.g. a flax/msgpack-only repo) fails at the import boundary with a clear message,
+    not deep inside the weights reader."""
+    import json
+
+    import pytest
+
+    from dolomite_engine_tpu.hf_interop import import_from_huggingface
+
+    src = tmp_path / "weightless"
+    src.mkdir()
+    (src / "config.json").write_text(json.dumps({"model_type": "llama"}))
+
+    with pytest.raises(ValueError, match="no supported weight format"):
+        import_from_huggingface(str(src), str(tmp_path / "out"))
+
+
+def test_import_bin_staging_dir_cleaned_up(tmp_path, monkeypatch):
+    """The temp staging dir for .bin conversion is removed even though the import succeeds."""
+    import glob
+    import tempfile
+
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dolomite_engine_tpu.hf_interop import import_from_huggingface
+
+    monkeypatch.setenv("TMPDIR", str(tmp_path / "tmp"))
+    (tmp_path / "tmp").mkdir()
+    tempfile.tempdir = None  # force re-read of TMPDIR
+
+    torch.manual_seed(0)
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        attention_bias=False,
+    )
+    LlamaForCausalLM(config).save_pretrained(tmp_path / "bin-ckpt", safe_serialization=False)
+
+    import_from_huggingface(str(tmp_path / "bin-ckpt"), str(tmp_path / "dolomite"))
+    leftovers = glob.glob(str(tmp_path / "tmp" / "dolomite-bin-convert-*"))
+    tempfile.tempdir = None  # don't leak the monkeypatched TMPDIR to later tests
+    assert leftovers == []

@@ -248,8 +248,9 @@ def test_moe_ep4_default_capacity_is_dropless(eight_devices):
     assert_allclose(losses["single"], losses["ep4"], atol=2e-4, rtol=2e-4)
 
 
-def test_moe_sp2_ep2_composition(eight_devices):
-    """sp>1 x ep>1 on one mesh: ring attention (batch over dp/fsdp/ep, seq over sp) composes
+@pytest.mark.parametrize("cp_impl", ["ring", "ulysses"])
+def test_moe_sp2_ep2_composition(eight_devices, cp_impl):
+    """sp>1 x ep>1 on one mesh: both CP schemes (batch over dp/fsdp/ep, seq over sp) compose
     with a2a expert dispatch (VERDICT r2 weak #5 — previously untested, and ring's batch_axes
     omitted "ep" so the batch silently all-gathered)."""
     from dolomite_engine_tpu.enums import AttentionImplementation
@@ -275,7 +276,7 @@ def test_moe_sp2_ep2_composition(eight_devices):
             dtype="fp32",
             sequence_length=32,
             zero_stage=3,
-            attention_implementation=AttentionImplementation.ring,
+            attention_implementation=AttentionImplementation(cp_impl),
             model_kwargs=dict(moe_implementation="eager"),
         )
         opt = _optimizer()

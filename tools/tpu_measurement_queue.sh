@@ -8,7 +8,9 @@ for i in $(seq 1 200); do
   if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
     echo "=== TPU recovered at $(date)"
     echo "=== bench.py driver config (splash now default)"
-    timeout 1200 python bench.py 2>&1 | tail -1
+    # retries off: this loop already waited for a live chip, and bench.py's re-exec retry
+    # (up to ~43 min) would outlive the outer timeout and eat the parseable JSON line
+    DOLOMITE_BENCH_RETRIES=0 timeout 1200 python bench.py 2>&1 | tail -1
     echo "=== splash+packed accum16"
     timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --packed --steps 5 2>&1 | tail -1
     echo "=== splash accum32"
