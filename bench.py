@@ -24,6 +24,14 @@ import numpy as np
 _PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
 
 
+def _reexec(env_updates: dict, msg: str) -> None:
+    """Fresh-interpreter restart with mutated env (claim retry / kernel fallback)."""
+    print(msg, file=sys.stderr)
+    sys.stderr.flush()
+    os.environ.update(env_updates)
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
 def _probe_backend(timeout_s: float = 600.0) -> str:
     """Resolve the backend with a watchdog: a wedged TPU claim (axon lease, PROFILE.md step 4)
     hangs jax.default_backend() forever. A blocked claim never completes in-process even
@@ -45,10 +53,11 @@ def _probe_backend(timeout_s: float = 600.0) -> str:
     if not result:
         retries = int(os.environ.get("DOLOMITE_BENCH_RETRIES", "3"))
         if retries > 0:
-            os.environ["DOLOMITE_BENCH_RETRIES"] = str(retries - 1)
-            print(f"TPU claim timed out; re-execing ({retries} retries left)", file=sys.stderr)
             time.sleep(60)
-            os.execv(sys.executable, [sys.executable] + sys.argv)
+            _reexec(
+                {"DOLOMITE_BENCH_RETRIES": str(retries - 1)},
+                f"TPU claim timed out; re-execing ({retries} retries left)",
+            )
         print(
             json.dumps(
                 {
@@ -180,12 +189,15 @@ def main() -> None:
     peak = _PEAK_TFLOPS.get(backend, 100.0)
     mfu = achieved_tflops / peak
 
+    # mark a kernel fallback in the stdout contract — a flash number must not be readable
+    # as the default (splash) config's number
+    fallback = ", legacy-flash-fallback" if os.environ.get("_DOLOMITE_BENCH_SPLASH_FALLBACK") else ""
     print(
         json.dumps(
             {
                 "metric": "pretrain_tokens_per_sec_per_chip",
                 "value": round(tokens_per_sec / n_devices, 2),
-                "unit": f"tokens/s/chip ({backend}, mfu={mfu:.3f}, step={step_time*1e3:.1f}ms)",
+                "unit": f"tokens/s/chip ({backend}, mfu={mfu:.3f}, step={step_time*1e3:.1f}ms{fallback})",
                 "vs_baseline": round(mfu / 0.40, 4),
             }
         )
@@ -195,6 +207,20 @@ def main() -> None:
 if __name__ == "__main__":
     try:
         main()
-    except Exception as e:  # always emit a parseable line
+    except Exception as e:
+        # splash is the faster kernel but has one on-chip datapoint; the legacy flash path
+        # measured vs_baseline 1.0081 — if the splash run trips anything post-claim (claim
+        # failures never reach here: _probe_backend exits), re-exec once on the proven path
+        # rather than emitting a zero. Deterministic non-kernel bugs pay one extra run
+        # (~4 min) before bench_error — acceptable insurance.
+        if os.environ.get("DOLOMITE_SPLASH_ATTENTION") == "1" and not os.environ.get(
+            "_DOLOMITE_BENCH_SPLASH_FALLBACK"
+        ):
+            _reexec(
+                {"DOLOMITE_SPLASH_ATTENTION": "0", "_DOLOMITE_BENCH_SPLASH_FALLBACK": "1"},
+                f"bench failed under splash ({e!r}); retrying with legacy flash "
+                "(error may be unrelated to the kernel — compare both runs' stderr)",
+            )
+        # always emit a parseable line
         print(json.dumps({"metric": "bench_error", "value": 0, "unit": str(e)[:200], "vs_baseline": 0}))
         sys.exit(1)
