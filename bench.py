@@ -23,6 +23,24 @@ import numpy as np
 # v5e peak bf16 TFLOP/s per chip (v5litepod). Other platforms for local fallback runs.
 _PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
 
+# Total wall-clock budget for the WHOLE bench, including every claim retry and the one-shot
+# kernel fallback — persisted across re-execs via _DOLOMITE_BENCH_START so re-execing never
+# resets the clock. Round 3's artifact died rc=124 because the retry loop (~43 min) outlived
+# the driver's timeout; the deadline guarantees one parseable JSON line prints well inside it.
+_START = float(os.environ.setdefault("_DOLOMITE_BENCH_START", repr(time.time())))
+_DEADLINE_S = float(os.environ.get("DOLOMITE_BENCH_DEADLINE", "1080"))
+# a full measured run after a successful claim: compile (~40-90s) + 15 steps (~130s) + margin
+_RUN_BUDGET_S = 330.0
+
+
+def _remaining() -> float:
+    return _DEADLINE_S - (time.time() - _START)
+
+
+def _emit_error(msg: str) -> None:
+    print(json.dumps({"metric": "bench_error", "value": 0, "unit": msg[:200], "vs_baseline": 0}))
+    sys.exit(1)
+
 
 def _reexec(env_updates: dict, msg: str) -> None:
     """Fresh-interpreter restart with mutated env (claim retry / kernel fallback)."""
@@ -32,15 +50,16 @@ def _reexec(env_updates: dict, msg: str) -> None:
     os.execv(sys.executable, [sys.executable] + sys.argv)
 
 
-def _probe_backend(timeout_s: float = 600.0) -> str:
+def _probe_backend() -> str:
     """Resolve the backend with a watchdog: a wedged TPU claim (axon lease, PROFILE.md step 4)
-    hangs jax.default_backend() forever. A blocked claim never completes in-process even
-    after the lease frees, so on timeout the script RE-EXECS itself (fresh interpreter,
-    fresh claim) up to DOLOMITE_BENCH_RETRIES times — the lease wedge is transient and this
-    is exactly the probe-loop pattern that recovers in tools/tpu_measurement_queue.sh —
-    before emitting one parseable bench_error line."""
+    hangs jax.default_backend() forever. A blocked claim never completes in-process even after
+    the lease frees, so on timeout the script RE-EXECS itself (fresh interpreter, fresh claim)
+    — but only while the total deadline leaves room for another probe AND a full run, so a
+    parseable line always prints before the driver's timeout."""
     import threading
 
+    # leave room for the measured run after the claim; a healthy chip claims in ~20-40s
+    timeout_s = max(60.0, min(420.0, _remaining() - _RUN_BUDGET_S))
     result: list[str] = []
 
     def probe():
@@ -52,24 +71,17 @@ def _probe_backend(timeout_s: float = 600.0) -> str:
     t.join(timeout_s)
     if not result:
         retries = int(os.environ.get("DOLOMITE_BENCH_RETRIES", "3"))
-        if retries > 0:
-            time.sleep(60)
+        if retries > 0 and _remaining() > _RUN_BUDGET_S + 120.0:
+            time.sleep(min(30.0, max(0.0, _remaining() - _RUN_BUDGET_S - 90.0)))
             _reexec(
                 {"DOLOMITE_BENCH_RETRIES": str(retries - 1)},
-                f"TPU claim timed out; re-execing ({retries} retries left)",
+                f"TPU claim timed out after {timeout_s:.0f}s; re-execing "
+                f"({retries} retries left, {_remaining():.0f}s of budget left)",
             )
-        print(
-            json.dumps(
-                {
-                    "metric": "bench_error",
-                    "value": 0,
-                    "unit": f"TPU claim did not complete within {timeout_s:.0f}s "
-                    "(wedged tunnel lease; see PROFILE.md step 4)",
-                    "vs_baseline": 0,
-                }
-            )
+        _emit_error(
+            f"TPU claim did not complete within the {_DEADLINE_S:.0f}s deadline "
+            "(wedged tunnel lease or backend outage; see PROFILE.md step 4)"
         )
-        sys.exit(1)
     return result[0]
 
 
@@ -81,7 +93,11 @@ def main() -> None:
     from dolomite_engine_tpu.model_wrapper.pretraining import ModelWrapperForPretraining
     from dolomite_engine_tpu.optimization import get_optimizer, get_scheduler
     from dolomite_engine_tpu.parallel.mesh import MeshManager, named_sharding
-    from dolomite_engine_tpu.train_utils import get_model_tflops, make_train_step
+    from dolomite_engine_tpu.train_utils import (
+        get_model_tflops,
+        make_train_step,
+        run_timed_windows,
+    )
     from dolomite_engine_tpu.distributed import create_sharded_train_state
 
     if on_tpu:
@@ -173,13 +189,25 @@ def main() -> None:
         state, metrics = jit_step(state, batch, rng)
         jax.block_until_ready(metrics["loss"])
 
-        t0 = time.perf_counter()
-        for i in range(steps):
-            state, metrics = jit_step(state, batch, jax.random.fold_in(rng, i))
-        jax.block_until_ready(metrics["loss"])
-        elapsed = time.perf_counter() - t0
+        # median of up to 3 independent timing windows (±12% tunnel session variance,
+        # PROFILE.md); stop early if the deadline budget runs low — a 1-window number
+        # beats a bench_error
+        state, window_times = run_timed_windows(
+            jit_step,
+            state,
+            batch,
+            rng,
+            steps,
+            windows=3 if on_tpu else 1,
+            should_continue=lambda wt: _remaining() >= max(90.0, 1.5 * steps * wt[-1]),
+        )
 
-    step_time = elapsed / steps
+    step_time = float(np.median(window_times))
+    spread = (
+        f", win[{min(window_times)*1e3:.0f}-{max(window_times)*1e3:.0f}ms x{len(window_times)}]"
+        if len(window_times) > 1
+        else ""
+    )
     tokens_per_step = accum * micro_bs * seq
     tokens_per_sec = tokens_per_step / step_time
     n_devices = jax.device_count()
@@ -197,7 +225,7 @@ def main() -> None:
             {
                 "metric": "pretrain_tokens_per_sec_per_chip",
                 "value": round(tokens_per_sec / n_devices, 2),
-                "unit": f"tokens/s/chip ({backend}, mfu={mfu:.3f}, step={step_time*1e3:.1f}ms{fallback})",
+                "unit": f"tokens/s/chip ({backend}, mfu={mfu:.3f}, step={step_time*1e3:.1f}ms{spread}{fallback})",
                 "vs_baseline": round(mfu / 0.40, 4),
             }
         )
@@ -211,10 +239,13 @@ if __name__ == "__main__":
         # splash is the faster kernel but has one on-chip datapoint; the legacy flash path
         # measured vs_baseline 1.0081 — if the splash run trips anything post-claim (claim
         # failures never reach here: _probe_backend exits), re-exec once on the proven path
-        # rather than emitting a zero. Deterministic non-kernel bugs pay one extra run
-        # (~4 min) before bench_error — acceptable insurance.
-        if os.environ.get("DOLOMITE_SPLASH_ATTENTION") == "1" and not os.environ.get(
-            "_DOLOMITE_BENCH_SPLASH_FALLBACK"
+        # rather than emitting a zero — but only when the deadline leaves room for a full
+        # second run, so a deterministic non-kernel bug can't push us past the driver's
+        # timeout with no parseable line (round-3 advisor finding).
+        if (
+            os.environ.get("DOLOMITE_SPLASH_ATTENTION") == "1"
+            and not os.environ.get("_DOLOMITE_BENCH_SPLASH_FALLBACK")
+            and _remaining() > _RUN_BUDGET_S + 90.0
         ):
             _reexec(
                 {"DOLOMITE_SPLASH_ATTENTION": "0", "_DOLOMITE_BENCH_SPLASH_FALLBACK": "1"},

@@ -139,7 +139,15 @@ def _write_latest(save_path: str, iteration: int) -> None:
         tmp = target + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"latest_checkpointed_iteration": iteration}, f)
+            f.flush()
+            os.fsync(f.fileno())  # machine crash: the rename must not survive with torn content
         os.replace(tmp, target)
+        # fsync the directory so the rename itself is durable
+        dir_fd = os.open(save_path, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
 
 def save_checkpoint(

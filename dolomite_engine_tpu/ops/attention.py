@@ -285,8 +285,14 @@ def attention(
             and causal
         )
         if implementation == AttentionImplementation.ulysses:
-            # the head all_to_all needs an even split of each tp shard's local heads
-            use_cp = use_cp and q.shape[2] % tp == 0 and (q.shape[2] // tp) % sp == 0
+            # the head all_to_all needs sp | local q-head count. Mirror the wrapper's
+            # shard_heads decision (ulysses_attention_sharded): when q or kv heads don't
+            # divide tp it runs with heads UNsharded, so the requirement is sp | Hq, not
+            # sp | Hq/tp — gating on the per-tp-shard count here would wrongly drop legal
+            # configs to sdpa and silently lose CP.
+            shard_heads = tp > 1 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0
+            local_heads = q.shape[2] // tp if shard_heads else q.shape[2]
+            use_cp = use_cp and local_heads % sp == 0
         if use_cp:
             cp_fn = (
                 ring_attention_sharded
@@ -316,7 +322,7 @@ def attention(
                 f"{cp_name} attention fell back to sdpa (requires: no kv cache, no "
                 "attention_mask — use packed segment_ids, no alibi, no dropout, causal, "
                 f"seq divisible by sp={sp}"
-                + (", sp | n_head/tp" if implementation == AttentionImplementation.ulysses else "")
+                + (", sp | local q heads" if implementation == AttentionImplementation.ulysses else "")
                 + ")",
             )
         implementation = AttentionImplementation.sdpa

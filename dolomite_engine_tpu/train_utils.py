@@ -152,6 +152,36 @@ def make_train_step(
     return train_step
 
 
+def run_timed_windows(
+    jit_step,
+    state,
+    batch,
+    rng: jax.Array,
+    steps: int,
+    windows: int,
+    should_continue: Callable[[list[float]], bool] | None = None,
+):
+    """Median-of-windows step timing shared by `bench.py` and `tools/bench_sweep.py`: run
+    up to `windows` blocks of `steps` steps, syncing once per block. Returns
+    (final_state, per-step window times); callers take the median — one 5-step window is
+    too noisy on a tunnel with ±12% session variance (PROFILE.md). `should_continue`
+    (given the times so far) can stop early, e.g. against a wall-clock deadline."""
+    import time as _time
+
+    window_times: list[float] = []
+    i = 0
+    for _ in range(max(windows, 1)):
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            state, metrics = jit_step(state, batch, jax.random.fold_in(rng, i))
+            i += 1
+        jax.block_until_ready(metrics["loss"])
+        window_times.append((_time.perf_counter() - t0) / steps)
+        if should_continue is not None and not should_continue(window_times):
+            break
+    return state, window_times
+
+
 def make_eval_step(loss_fn: Callable):
     def eval_step(params, batch, fp8_state=None):
         if fp8_state is not None:

@@ -11,7 +11,12 @@ cd "$(dirname "$0")/.."
 WORK="${1:-/tmp/dolomite-quickstart}"
 export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
-rm -rf "$WORK" && mkdir -p "$WORK"
+# only wipe a directory this script created (marker file), never arbitrary user data
+if [ -e "$WORK" ] && [ ! -f "$WORK/.dolomite-quickstart" ]; then
+  echo "refusing to delete pre-existing '$WORK' (no .dolomite-quickstart marker); pass a fresh path" >&2
+  exit 1
+fi
+rm -rf "$WORK" && mkdir -p "$WORK" && touch "$WORK/.dolomite-quickstart"
 
 echo "=== 1/6 tokenizer + raw corpus"
 python - "$WORK" <<'EOF'

@@ -160,3 +160,33 @@ def test_sharded_train_step_with_ulysses(mesh_sp4):
 
     assert np.isfinite(losses["ulysses"])
     assert_allclose(losses["ulysses"], losses["ring"], atol=2e-5, rtol=2e-5)
+
+
+@pytest.fixture()
+def mesh_sp2_tp4(eight_devices):
+    MeshManager(sequence_parallel_size=2, tensor_parallel_size=4)
+    yield MeshManager.get_mesh()
+    MeshManager.destroy()
+
+
+def test_ulysses_gate_mirrors_wrapper_head_sharding(mesh_sp2_tp4, monkeypatch):
+    """Hq=6 doesn't divide tp=4, so the wrapper runs heads UNsharded and only needs
+    sp | Hq (6 % 2 == 0). The dispatch gate must ride CP here — gating on the per-tp-shard
+    head count (Hq/tp) wrongly dropped this legal config to sdpa and silently lost CP
+    (round-3 advisor finding, ops/attention.py)."""
+    import dolomite_engine_tpu.ops.ulysses_attention as ua
+
+    calls = []
+    real = ua.ulysses_attention_sharded
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ua, "ulysses_attention_sharded", spy)
+    q, k, v = make_qkv(Hq=6, Hkv=6, seed=5)
+    ref = sdpa_attention(q, k, v, make_attention_mask(4, 32, 32, causal=True), None, 8**-0.5)
+    with mesh_sp2_tp4:
+        out = attention(q, k, v, implementation=AttentionImplementation.ulysses)
+    assert calls, "legal ulysses config (heads unsharded, sp | Hq) fell back to sdpa"
+    assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

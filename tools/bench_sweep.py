@@ -45,6 +45,19 @@ def main() -> None:
     p.add_argument("--packed", action="store_true", help="packed segment-ids path (reset_attention_mask)")
     p.add_argument("--moe", type=int, default=0, help="num_experts (0 = dense gpt_dolomite)")
     p.add_argument("--top_k", type=int, default=2, help="experts per token (with --moe)")
+    p.add_argument("--model_type", type=str, default=None,
+                   choices=["gpt_dolomite", "moe_dolomite", "dense_moe", "rnn_dolomite",
+                            "gpt_crosslayer"],
+                   help="model family (default gpt_dolomite; --moe implies moe_dolomite)")
+    p.add_argument("--n_inner", type=int, default=0, help="MLP inner dim (0 = 4*n_embd)")
+    p.add_argument("--kv_sharing", type=int, default=2,
+                   help="gpt_crosslayer: consecutive layers sharing one KV (group size)")
+    p.add_argument("--attention_pattern", type=str, default=None,
+                   help="rnn_dolomite layer pattern over {a,d} (default: 'ad'*... mix)")
+    p.add_argument("--offload", action="store_true",
+                   help="cpu_offload: optimizer state in pinned_host memory (TPU only)")
+    p.add_argument("--windows", type=int, default=1,
+                   help="timing windows of --steps each; reports the median window")
     args = p.parse_args()
 
     if args.splash:
@@ -80,12 +93,31 @@ def main() -> None:
         fused_lm_head_loss=args.fused_loss,
         loss_chunk_size=args.loss_chunk,
     )
-    if args.moe:
+    if args.n_inner:
+        config["n_inner"] = args.n_inner
+    model_type = args.model_type or ("moe_dolomite" if args.moe else "gpt_dolomite")
+    if model_type == "moe_dolomite":
         config.update(
             model_type="moe_dolomite",
-            num_experts=args.moe,
+            num_experts=args.moe or 8,
             num_experts_per_tok=args.top_k,
             router_aux_loss_coef=0.01,
+        )
+    elif model_type == "dense_moe":
+        # dense_moe forces num_key_value_heads = num_experts (models/config.py)
+        config.pop("num_key_value_heads")
+        config.update(model_type="dense_moe", num_experts=args.moe or 8)
+    elif model_type == "rnn_dolomite":
+        # default: the reference-style hybrid — 1 attention layer per 4 DeltaNet layers
+        pattern = args.attention_pattern or (
+            "ddda" * (args.n_layer // 4) + "d" * (args.n_layer % 4)
+        )
+        config.update(model_type="rnn_dolomite", attention_pattern=pattern)
+    elif model_type == "gpt_crosslayer":
+        g = args.kv_sharing
+        config.update(
+            model_type="gpt_crosslayer",
+            sharing_pattern=[(i // g) * g for i in range(args.n_layer)],
         )
 
     MeshManager()
@@ -115,19 +147,29 @@ def main() -> None:
     if args.mu_dtype:
         opt_kwargs["mu_dtype"] = args.mu_dtype
     opt = get_optimizer("TorchAdamW", opt_kwargs, sched)
-    state, _ = create_sharded_train_state(wrapper, opt, mesh, jax.random.PRNGKey(0))
+    offload = args.offload and backend == "tpu"
+    state, _ = create_sharded_train_state(
+        wrapper, opt, mesh, jax.random.PRNGKey(0), offload_optimizer=offload
+    )
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
 
     def loss_fn(params, micro, rng, fp8_state=None):
         return wrapper.loss(params, micro["text"], train=True, fp8_state=fp8_state)
 
-    step_fn = make_train_step(loss_fn, opt, gradient_accumulation_steps=args.accum)
+    step_fn = make_train_step(
+        loss_fn, opt, gradient_accumulation_steps=args.accum, offload_optimizer=offload
+    )
     tokens = np.random.RandomState(0).randint(
         0, config["vocab_size"], size=(args.accum, args.micro_bs, args.seq + 1)
     ).astype(np.int32)
 
     with mesh:
-        jit_step = jax.jit(step_fn, donate_argnums=0)
+        jit_kwargs = {"donate_argnums": 0}
+        if offload:
+            from dolomite_engine_tpu.train_utils import offload_jit_kwargs
+
+            jit_kwargs.update(offload_jit_kwargs(state))
+        jit_step = jax.jit(step_fn, **jit_kwargs)
         batch = {"text": jax.device_put(jnp.asarray(tokens), named_sharding(None, ("dp", "fsdp")))}
         rng = jax.random.PRNGKey(1)
 
@@ -141,13 +183,13 @@ def main() -> None:
                 state, metrics = jit_step(state, batch, rng)
                 jax.block_until_ready(metrics["loss"])
 
-        t0 = time.perf_counter()
-        for i in range(args.steps):
-            state, metrics = jit_step(state, batch, jax.random.fold_in(rng, i))
-        jax.block_until_ready(metrics["loss"])
-        elapsed = time.perf_counter() - t0
+        from dolomite_engine_tpu.train_utils import run_timed_windows
 
-    step_time = elapsed / args.steps
+        state, window_times = run_timed_windows(
+            jit_step, state, batch, rng, args.steps, windows=args.windows
+        )
+
+    step_time = float(np.median(window_times))
     tokens_per_step = args.accum * args.micro_bs * args.seq
     n_devices = jax.device_count()
     model_tflops = get_model_tflops(
@@ -169,9 +211,11 @@ def main() -> None:
         pass
 
     print(json.dumps({
-        "n_embd": args.n_embd, "n_layer": args.n_layer, "micro_bs": args.micro_bs,
+        "model": model_type, "n_embd": args.n_embd, "n_layer": args.n_layer,
+        "micro_bs": args.micro_bs,
         "accum": args.accum, "ckpt": args.ckpt, "params_m": round(n_params / 1e6, 1),
         "mfu": round(mfu, 4), "step_ms": round(step_time * 1e3, 1),
+        "win_ms": [round(w * 1e3, 1) for w in window_times],
         "tok_s": round(tokens_per_step / step_time / n_devices, 0),
         "compile_s": round(compile_s, 1), **mem,
     }))

@@ -1,40 +1,65 @@
 #!/bin/bash
-# Pending on-chip measurements (round 3, updated after the splash/packed/driver-config
-# results landed — PROFILE.md step 3b). The axon lease wedged again mid-round (step 4);
-# run this when a chip is reachable. Order matters: OOM-risky runs LAST — an OOM'd remote
-# compile can wedge the lease for every following run.
+# Pending on-chip measurements (round 4). Waits up to ~6.6h for the tunneled TPU to come
+# back, then runs every queued measurement sequentially. Order matters: OOM-risky runs
+# LAST — an OOM'd remote compile can wedge the lease for every following run.
+#
+# Run in background, tee the output:  bash tools/tpu_measurement_queue.sh 2>&1 | tee /tmp/queue_r4.log
 cd /root/repo
+
+SW="timeout 900 python tools/bench_sweep.py"
+
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
     echo "=== TPU recovered at $(date)"
-    echo "=== bench.py driver config (splash now default)"
-    # retries off: this loop already waited for a live chip, and bench.py's re-exec retry
-    # (up to ~43 min) would outlive the outer timeout and eat the parseable JSON line
-    DOLOMITE_BENCH_RETRIES=0 timeout 1200 python bench.py 2>&1 | tail -1
-    echo "=== splash+packed accum16"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --packed --steps 5 2>&1 | tail -1
-    echo "=== splash accum32"
-    timeout 1200 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 32 --fused_loss --splash --steps 3 2>&1 | tail -1
-    echo "=== latency-hiding scheduler A/B (splash accum16)"
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
-    echo "=== loss_chunk 512 A/B (splash accum16)"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --loss_chunk 512 --splash --steps 5 2>&1 | tail -1
-    echo "=== head_dim 128 A/B: 1024x24 n_head 8 kv 4, splash accum16"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --n_head 8 --kv_heads 4 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
-    echo "=== MoE 8x top2 (scatter ragged_dot, splash)"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 12 --micro_bs 8 --accum 8 --fused_loss --splash --moe 8 --top_k 2 --steps 5 2>&1 | tail -1
+
+    echo "=== bench.py driver config (splash default, median-of-3 windows)"
+    # retries off: this loop already waited for a live chip; deadline keeps one parseable
+    # line inside the outer timeout even if the one-shot kernel fallback triggers
+    DOLOMITE_BENCH_RETRIES=0 DOLOMITE_BENCH_DEADLINE=1100 timeout 1200 python bench.py 2>&1 | tail -1
+
+    echo "=== A/B: splash+packed accum16"
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --packed --windows 3 --steps 5 2>&1 | tail -1
+    echo "=== A/B: splash accum32"
+    timeout 1200 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 32 --fused_loss --splash --windows 3 --steps 3 2>&1 | tail -1
+    echo "=== A/B: latency-hiding scheduler (splash accum16)"
+    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
+    echo "=== A/B: loss_chunk 512 (splash accum16)"
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --loss_chunk 512 --splash --windows 3 --steps 5 2>&1 | tail -1
+    echo "=== A/B: head_dim 128 (1024x24 n_head 8 kv 4, splash accum16)"
+    $SW --n_embd 1024 --n_layer 24 --n_head 8 --kv_heads 4 --micro_bs 8 --accum 16 --fused_loss --splash --windows 3 --steps 5 2>&1 | tail -1
+
+    echo "=== Granite-3B shape, head_dim 80: 2560x6 n_head 32 kv 8, n_inner 10240, mu_bf16"
+    $SW --n_embd 2560 --n_layer 6 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 5 2>&1 | tail -1
+    echo "=== Granite-3B shape, head_dim 128: 2560x6 n_head 20 kv 10, n_inner 10240, mu_bf16"
+    $SW --n_embd 2560 --n_layer 6 --n_head 20 --kv_heads 10 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 5 2>&1 | tail -1
+
+    echo "=== family: MoE 8x top2 (ragged_dot scatter, splash)"
+    $SW --n_embd 1024 --n_layer 12 --micro_bs 8 --accum 8 --fused_loss --splash --moe 8 --top_k 2 --windows 3 --steps 5 2>&1 | tail -1
+    echo "=== family: DenseMoE 8 experts (wide soft-routed MLP)"
+    $SW --model_type dense_moe --moe 8 --n_embd 1024 --n_layer 8 --n_head 16 --micro_bs 4 --accum 8 --fused_loss --windows 3 --steps 5 2>&1 | tail -1
+    echo "=== family: RNNDolomite (ddda hybrid, chunked delta rule)"
+    $SW --model_type rnn_dolomite --n_embd 1024 --n_layer 24 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --windows 3 --steps 5 2>&1 | tail -1
+    echo "=== family: GPTCrossLayer (kv_sharing 2, splash)"
+    $SW --model_type gpt_crosslayer --n_embd 1024 --n_layer 24 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --windows 3 --steps 5 2>&1 | tail -1
+
     echo "=== long context seq 8192 (splash, ckpt 1)"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 2 --accum 8 --seq 8192 --fused_loss --splash --ckpt 1 --steps 3 2>&1 | tail -1
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 2 --accum 8 --seq 8192 --fused_loss --splash --ckpt 1 --windows 2 --steps 3 2>&1 | tail -1
     echo "=== generation bench (host-fetch timing)"
     timeout 900 python tools/bench_generation.py 2>&1 | tail -1
+
     echo "=== bf16 control mb4 accum8 (for the fp8 delta)"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --steps 5 2>&1 | tail -1
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --windows 3 --steps 5 2>&1 | tail -1
     echo "=== fp8 mb4 accum8 (OOM risk from here down)"
-    timeout 900 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --dtype fp8 --steps 5 2>&1 | tail -3
-    echo "=== 1536x16 n_head 12 kv 6 splash mu_bf16 accum8"
-    timeout 900 python tools/bench_sweep.py --n_embd 1536 --n_layer 16 --n_head 12 --kv_heads 6 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --steps 5 2>&1 | tail -1
-    echo "=== 2048x12 n_head 16 kv 8 splash mu_bf16 ckpt1+dots accum8"
-    timeout 900 python tools/bench_sweep.py --n_embd 2048 --n_layer 12 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --ckpt_policy dots_saveable --steps 5 2>&1 | tail -1
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --dtype fp8 --windows 2 --steps 5 2>&1 | tail -3
+    echo "=== cpu_offload: Granite shape 2560x8 WITH offload (should fit)"
+    $SW --n_embd 2560 --n_layer 8 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --offload --windows 2 --steps 3 2>&1 | tail -1
+    echo "=== control: Granite shape 2560x8 WITHOUT offload (may OOM — proves offload's value)"
+    $SW --n_embd 2560 --n_layer 8 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 3 2>&1 | tail -1
+    echo "=== chip-filling: 1536x16 n_head 12 kv 6 splash mu_bf16 accum8"
+    $SW --n_embd 1536 --n_layer 16 --n_head 12 --kv_heads 6 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --windows 2 --steps 5 2>&1 | tail -1
+    echo "=== chip-filling: 2048x12 n_head 16 kv 8 splash mu_bf16 ckpt1+dots accum8"
+    $SW --n_embd 2048 --n_layer 12 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --ckpt_policy dots_saveable --windows 2 --steps 5 2>&1 | tail -1
+
     echo "=== done at $(date)"
     exit 0
   fi
