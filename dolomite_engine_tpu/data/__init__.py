@@ -91,6 +91,13 @@ def get_dataloader(
     """Blended finetuning dataloader. Each host samples its own strided shard
     (num_replicas = process_count); the ShardedDataLoader assembles global arrays."""
     assert mode == Mode.training, "blended dataset is only supported in training mode"
+    # reference `_setup_tokenizer` hard-requires one ("pass a tokenizer",
+    # model_wrapper/base.py:166); here the tokenizer is optional for megatron pretraining
+    # on token bins, so the finetuning data path must check before collate dereferences it
+    assert tokenizer is not None, (
+        "finetuning data pipeline requires a tokenizer: set model_args.model_name or "
+        "model_args.tokenizer_name"
+    )
 
     datasets_list, data_sampling_ratios = get_datasets_list(
         dataset_args_list=args.datasets,

@@ -111,11 +111,16 @@ def collate_fn(
     if not use_padding_free_transformer:
         result["attention_mask"] = np.asarray(attention_mask, dtype=np.int32)
     if mode == Mode.training and labels is not None:
-        # labels are aligned to input positions; shift left for next-token prediction
         labels_arr = np.asarray(labels, dtype=np.int32)
-        shifted = np.full_like(labels_arr, labels_mask_value)
-        shifted[:, :-1] = labels_arr[:, 1:]
-        result["labels"] = shifted
+        if is_encoder_decoder:
+            # decoder targets as-is (reference data/utils.py:44-49); the seq2seq model
+            # builds the shifted-right decoder inputs itself (enc_dec_dolomite.shift_right)
+            result["labels"] = labels_arr
+        else:
+            # labels are aligned to input positions; shift left for next-token prediction
+            shifted = np.full_like(labels_arr, labels_mask_value)
+            shifted[:, :-1] = labels_arr[:, 1:]
+            result["labels"] = shifted
     return result
 
 

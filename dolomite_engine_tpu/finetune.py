@@ -258,12 +258,22 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
     mesh = build_mesh_from_args(args)
 
     train_dataloader = get_dataloader(
-        args, DatasetSplit.train, mode, model.tokenizer, mesh=mesh
+        args,
+        DatasetSplit.train,
+        mode,
+        model.tokenizer,
+        is_encoder_decoder=model.is_encoder_decoder,
+        mesh=mesh,
     )
     val_dataloader = None
     if args.training_parameters.eval_during_training:
         val_dataloader = get_dataloader(
-            args, DatasetSplit.val, mode, model.tokenizer, mesh=mesh
+            args,
+            DatasetSplit.val,
+            mode,
+            model.tokenizer,
+            is_encoder_decoder=model.is_encoder_decoder,
+            mesh=mesh,
         )
 
     optimizer, lr_schedule = build_optimizer_from_args(args, model)

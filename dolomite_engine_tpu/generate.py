@@ -50,7 +50,7 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
                         mode=mode,
                         loss_mask=None,
                         eos_token_id=model.eos_token_id,
-                        is_encoder_decoder=False,
+                        is_encoder_decoder=model.is_encoder_decoder,
                         use_padding_free_transformer=False,
                     )
                     # static shapes: pad prompt width to a bucket and the (possibly ragged
@@ -139,6 +139,7 @@ def main(args: InferenceArgs | None = None) -> None:
         split=DatasetSplit.test,
         mode=mode,
         tokenizer=model.tokenizer,
+        is_encoder_decoder=model.is_encoder_decoder,
     )
 
     generate(args, model, params, datasets_list, mode)

@@ -113,25 +113,96 @@ def generate_tokens(
     )
     generated = jnp.concatenate([first_token[:, None], rest.T], axis=1)  # [B, max_new]
 
+    return _trim_after_eos(generated, max_new_tokens, eos_token_id, pad_token_id)
+
+
+def _trim_after_eos(
+    generated: jax.Array, max_new_tokens: int, eos_token_id: int | None, pad_token_id: int
+) -> tuple[jax.Array, jax.Array]:
+    """HF stop semantics: count tokens through the first EOS, pad everything after."""
+    batch = generated.shape[0]
     if eos_token_id is None:
-        num_generated = jnp.full((batch,), max_new_tokens, jnp.int32)
-    else:
-        is_eos = generated == eos_token_id
-        any_eos = jnp.any(is_eos, axis=1)
-        first_eos = jnp.argmax(is_eos, axis=1)
-        num_generated = jnp.where(any_eos, first_eos + 1, max_new_tokens).astype(jnp.int32)
-        # blank everything after the first EOS
-        keep = jnp.arange(max_new_tokens)[None, :] < num_generated[:, None]
-        generated = jnp.where(keep, generated, pad_token_id)
-
-    return generated, num_generated
+        return generated, jnp.full((batch,), max_new_tokens, jnp.int32)
+    is_eos = generated == eos_token_id
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1)
+    num_generated = jnp.where(any_eos, first_eos + 1, max_new_tokens).astype(jnp.int32)
+    keep = jnp.arange(max_new_tokens)[None, :] < num_generated[:, None]
+    return jnp.where(keep, generated, pad_token_id), num_generated
 
 
-def make_generate_fn(model: Any, **static_kwargs):
+def generate_seq2seq_tokens(
+    model: Any,
+    params: Any,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int,
+    do_sample: bool = False,
+    temperature: float | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_token_id: int | None = None,
+    pad_token_id: int = 0,
+    decoder_start_token_id: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Encoder-decoder decode: one encoder pass, then `lax.scan` over decoder steps with the
+    standard self-attention KV cache; cross-attention K/V recompute from the static encoder
+    output each step (models/enc_dec_dolomite.py). Prompts are the ENCODER inputs
+    (left-padded, like the decoder-only path); the decoder starts from
+    `decoder_start_token_id`."""
+    batch = input_ids.shape[0]
+    variables = {"params": params} if "params" not in params else params
+
+    encoder_hidden_states = model.apply(
+        variables, input_ids, attention_mask, method="encode"
+    )
+    caches = model.init_kv_caches(batch, max_new_tokens + 1)
+    start = jnp.full((batch,), decoder_start_token_id, jnp.int32)
+    finished0 = jnp.zeros((batch,), bool)
+
+    def step(carry, i):
+        caches, token, finished, rng = carry
+        out = model.apply(
+            variables,
+            input_ids,
+            attention_mask=attention_mask,
+            decoder_input_ids=token[:, None],
+            encoder_hidden_states=encoder_hidden_states,
+            kv_caches=caches,
+            cache_index=i,
+        )
+        rng, step_rng = jax.random.split(rng)
+        next_token = sample_token(
+            out.logits[:, -1],
+            step_rng,
+            do_sample=do_sample,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+        )
+        next_token = jnp.where(finished, pad_token_id, next_token)
+        next_finished = finished
+        if eos_token_id is not None:
+            next_finished = finished | (next_token == eos_token_id)
+        return (out.kv_caches, next_token, next_finished, rng), next_token
+
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (caches, start, finished0, rng), jnp.arange(max_new_tokens)
+    )
+    generated = toks.T  # [B, max_new_tokens]
+    return _trim_after_eos(generated, max_new_tokens, eos_token_id, pad_token_id)
+
+
+def make_generate_fn(model: Any, is_encoder_decoder: bool = False, **static_kwargs):
     """Jitted decode closure over a fixed model + generation settings; cache one per
-    (settings, shape) combination — e.g. `ModelWrapper.generate` keeps a dict."""
+    (settings, shape) combination — e.g. `ModelWrapper.generate` keeps a dict.
+    `is_encoder_decoder` routes to the seq2seq decode path (the caller knows the family
+    from the registry — `models.is_encoder_decoder_model`; duck-typing on method names
+    would make an unrelated `encode` attribute change decode semantics)."""
+    decode = generate_seq2seq_tokens if is_encoder_decoder else generate_tokens
 
     def fn(params, input_ids, attention_mask, rng):
-        return generate_tokens(model, params, input_ids, attention_mask, rng, **static_kwargs)
+        return decode(model, params, input_ids, attention_mask, rng, **static_kwargs)
 
     return jax.jit(fn)

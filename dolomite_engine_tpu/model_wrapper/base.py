@@ -59,17 +59,11 @@ class ModelWrapper:
         self.model_name = model_name
         self.model_kwargs = model_kwargs or {}  # extra module fields (e.g. moe_implementation)
 
-        # Encoder-decoder is NOT implemented: the model registry (models/__init__.py) is
-        # decoder-only. The reference finetunes AutoModelForSeq2SeqLM
-        # (model_wrapper/base.py:42-83); here a seq2seq config must fail loudly rather than
-        # silently train a causal LM. The data layer's is_encoder_decoder plumbing
-        # (data/base.py) is kept so the input/output formatting parity tests still cover it.
-        if model_class == "AutoModelForSeq2SeqLM":
-            raise NotImplementedError(
-                "model_class=AutoModelForSeq2SeqLM (encoder-decoder) is not supported by "
-                "dolomite_engine_tpu; only decoder-only (AutoModelForCausalLM) model families "
-                "are registered. Use the reference engine for seq2seq finetuning."
-            )
+        # model_class selects the training surface (reference model_wrapper/base.py:42-83):
+        # AutoModelForSeq2SeqLM drives the encoder-decoder families (enc_dec_dolomite),
+        # AutoModelForCausalLM the decoder-only ones; validated against the resolved
+        # config's model_type after _setup_config below.
+        self.model_class = model_class
         # fp8 = bf16 compute + delayed-scaling fp8 dots in the linears (ops/fp8.py; reference
         # distributed/fp8/ selects TE/MS-AMP from MixedPrecisionArgs the same way)
         self.use_fp8 = dtype == "fp8"
@@ -86,6 +80,18 @@ class ModelWrapper:
 
         self.config_extras = config_extras
         self._setup_config(model_name, pretrained_config)
+
+        from ..models import is_encoder_decoder_model
+
+        if is_encoder_decoder_model(self.model_type) != (
+            self.model_class == "AutoModelForSeq2SeqLM"
+        ):
+            raise ValueError(
+                f"model_class '{self.model_class}' does not match model_type "
+                f"'{self.model_type}': encoder-decoder families require "
+                "AutoModelForSeq2SeqLM, decoder-only families AutoModelForCausalLM"
+            )
+
         self._setup_tokenizer(tokenizer_name, additional_special_tokens)
 
         checkpoint_every = 0
@@ -196,8 +202,17 @@ class ModelWrapper:
         )
 
     # ------------------------------------------------------------------ params
+    @property
+    def is_encoder_decoder(self) -> bool:
+        from ..models import is_encoder_decoder_model
+
+        return is_encoder_decoder_model(self.model_type)
+
     def get_dummy_inputs(self) -> dict:
-        return {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+        dummy = {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+        if self.is_encoder_decoder:
+            dummy["decoder_input_ids"] = jnp.zeros((1, 8), jnp.int32)
+        return dummy
 
     def abstract_boxed_params(self):
         """Shape/dtype tree with flax Partitioned boxes (for logical-spec derivation)."""
@@ -297,11 +312,15 @@ class ModelWrapper:
             eos_token_id=self.eos_token_id,
             pad_token_id=self.tokenizer.pad_token_id or self.eos_token_id or 0,
         )
+        if self.is_encoder_decoder:
+            static["decoder_start_token_id"] = self.config.decoder_start_token_id
         cache_key = tuple(sorted(static.items()))
         if not hasattr(self, "_generate_fns"):
             self._generate_fns = {}
         if cache_key not in self._generate_fns:
-            self._generate_fns[cache_key] = make_generate_fn(self.model, **static)
+            self._generate_fns[cache_key] = make_generate_fn(
+                self.model, is_encoder_decoder=self.is_encoder_decoder, **static
+            )
         generated, num_generated = self._generate_fns[cache_key](
             params, input_ids, attention_mask, rng
         )

@@ -49,6 +49,12 @@ class ModelWrapperForPretraining(ModelWrapper):
         self.reset_attention_mask = reset_attention_mask
         self.reset_position_ids = reset_position_ids
         super().__init__(*args, **kwargs)
+        if self.is_encoder_decoder:
+            raise ValueError(
+                "pretraining consumes causal token streams; encoder-decoder families are "
+                "trained through finetuning (tuning_method: full_finetuning), as in the "
+                "reference (seq2seq enters via AutoModelForSeq2SeqLM finetuning only)"
+            )
 
     def get_dummy_inputs(self) -> dict:
         seq = self.sequence_length or 8
@@ -114,14 +120,18 @@ class ModelWrapperForFinetuning(ModelWrapper):
         train: bool = True,
         fp8_state=None,
     ):
+        # seq2seq: input_ids/attention_mask feed the encoder; labels are decoder targets and
+        # the model derives decoder_input_ids by shifting them right
+        # (models/enc_dec_dolomite.py shift_right)
         inputs = {
             "input_ids": batch["input_ids"],
             "attention_mask": batch.get("attention_mask"),
             "labels": batch["labels"],
-            # padding-free packed batches carry these instead of attention_mask
-            "position_ids": batch.get("position_ids"),
-            "segment_ids": batch.get("segment_ids"),
         }
+        if not self.is_encoder_decoder:
+            # padding-free packed batches carry these instead of attention_mask
+            inputs["position_ids"] = batch.get("position_ids")
+            inputs["segment_ids"] = batch.get("segment_ids")
         if self.neft_alpha is not None and train:
             # NEFTune (reference base.py:246-266): uniform noise scaled by alpha/sqrt(N*d)
             # added to input embeddings; implemented via the models' embedding_noise rng hook.

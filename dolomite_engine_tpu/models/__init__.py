@@ -10,12 +10,14 @@ GSPMD every registered model is tensor-parallel capable (sharding is declarative
 from .config import (
     CommonConfig,
     DenseMoEConfig,
+    EncDecDolomiteConfig,
     GPTCrossLayerConfig,
     MoEConfig,
     RNNDolomiteConfig,
 )
 from .gpt_dolomite import CausalLMOutput, GPTDolomiteForCausalLM, GPTDolomiteModel
 from .dense_moe import DenseMoEForCausalLM, DenseMoEModel
+from .enc_dec_dolomite import EncDecDolomiteForSeq2SeqLM
 from .gpt_crosslayer import (
     GPTCrossLayerForCausalLM,
     GPTCrossLayerModel,
@@ -30,6 +32,7 @@ _CONFIG_CLASSES: dict[str, type] = {
     "gpt_crosslayer": GPTCrossLayerConfig,
     "dense_moe": DenseMoEConfig,
     "rnn_dolomite": RNNDolomiteConfig,
+    "enc_dec_dolomite": EncDecDolomiteConfig,
 }
 
 _MODEL_CLASSES: dict[str, type] = {
@@ -38,12 +41,24 @@ _MODEL_CLASSES: dict[str, type] = {
     "gpt_crosslayer": GPTCrossLayerForCausalLM,
     "dense_moe": DenseMoEForCausalLM,
     "rnn_dolomite": RNNDolomiteForCausalLM,
+    "enc_dec_dolomite": EncDecDolomiteForSeq2SeqLM,
 }
 
+# families trained/driven through the seq2seq (AutoModelForSeq2SeqLM) surface
+_ENCODER_DECODER_TYPES = {"enc_dec_dolomite"}
 
-def register_model(model_type: str, config_cls: type, model_cls: type) -> None:
+
+def is_encoder_decoder_model(model_type: str) -> bool:
+    return model_type in _ENCODER_DECODER_TYPES
+
+
+def register_model(
+    model_type: str, config_cls: type, model_cls: type, is_encoder_decoder: bool = False
+) -> None:
     _CONFIG_CLASSES[model_type] = config_cls
     _MODEL_CLASSES[model_type] = model_cls
+    if is_encoder_decoder:
+        _ENCODER_DECODER_TYPES.add(model_type)
 
 
 def get_config_class(model_type: str) -> type:

@@ -201,6 +201,42 @@ class DenseMoEConfig(CommonConfig):
 
 
 @dataclass
+class EncDecDolomiteConfig(CommonConfig):
+    """Encoder-decoder family backing `model_class: AutoModelForSeq2SeqLM` (reference
+    `arguments.py:72-76` accepts HF encoder-decoders; the registry here is from-scratch, so
+    seq2seq gets its own small family instead — same pre-norm blocks as GPTDolomite plus a
+    bidirectional encoder and per-decoder-block cross-attention). `n_layer` counts decoder
+    blocks; `n_encoder_layer` defaults to the same. `decoder_start_token_id` seeds the
+    shifted-right decoder input (HF seq2seq convention)."""
+
+    model_type: str = "enc_dec_dolomite"
+    position_embedding_type: str = "rope"  # the only type the enc-dec stacks implement
+    n_encoder_layer: int | None = None
+    decoder_start_token_id: int | None = None
+    # residual-branch count for depth-scaled init (modeling_utils.depth_scaled_init_std);
+    # set internally per stack — encoder blocks have 2 branches, decoder blocks 3
+    init_residual_branches: int | None = None
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        # the model builds neither wpe nor alibi bias — accepting those configs would train
+        # a silently position-blind model
+        assert self.position_embedding_type == "rope", (
+            "enc_dec_dolomite supports position_embedding_type='rope' only "
+            f"(got '{self.position_embedding_type}')"
+        )
+        # the LM head is always the shared wte table; accepting untied would silently train
+        # a tied model under an untied config
+        assert self.tie_word_embeddings, "enc_dec_dolomite requires tie_word_embeddings"
+        if self.n_encoder_layer is None:
+            self.n_encoder_layer = self.n_layer
+        if self.decoder_start_token_id is None:
+            self.decoder_start_token_id = (
+                self.bos_token_id if self.bos_token_id is not None else (self.pad_token_id or 0)
+            )
+
+
+@dataclass
 class RNNDolomiteConfig(CommonConfig):
     """Parity: reference `hf_models/models/rnn_dolomite/config.py`: hybrid DeltaNet/attention;
     `attention_pattern` is a string over {'d' (DeltaNet), 'a' (attention)} of length n_layer."""

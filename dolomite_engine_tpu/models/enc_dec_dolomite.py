@@ -1,0 +1,317 @@
+"""EncDecDolomite: the encoder-decoder (seq2seq) family.
+
+Parity: the reference finetunes HF `AutoModelForSeq2SeqLM` encoder-decoders end-to-end
+(`/root/reference/dolomite_engine/arguments.py:72-76`; encoder-decoder collate at
+`/root/reference/dolomite_engine/data/utils.py:30-60`). This registry is from-scratch, so
+instead of porting T5, seq2seq is backed by a small native family reusing the GPTDolomite
+building blocks: bidirectional pre-norm encoder (`Block(causal=False)`), decoder blocks with
+causal self-attention + cross-attention over the encoder output, shared token embedding,
+tied LM head, RoPE positions in both self-attention stacks (design choice over T5's relative
+bias — one rotary implementation serves every family).
+
+Training follows the HF seq2seq convention: `labels` are the decoder targets
+(IGNORE_INDEX-padded); `decoder_input_ids` default to labels shifted RIGHT with
+`decoder_start_token_id` (the wrapper never needs to build them).
+
+Generation: `encode()` runs once; decode steps reuse the standard self-attention KV cache
+machinery (`modeling_utils.update_kv_cache`) while cross-attention K/V are recomputed from
+the static encoder output each chunk — static shapes, no cross cache plumbing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..enums import AttentionImplementation
+from ..ops.loss import IGNORE_INDEX, cross_entropy_loss
+from ..ops.rope import RoPEParams
+from .config import EncDecDolomiteConfig
+from .enums import PositionEmbeddingType
+from .gpt_dolomite import resolve_remat_policy
+from .modeling_utils import (
+    Block,
+    CrossAttention,
+    KVCache,
+    ParameterizedEmbedding,
+    compute_position_stuff,
+    get_norm,
+)
+
+
+@dataclass
+class Seq2SeqOutput:
+    logits: jax.Array | None = None
+    loss: jax.Array | None = None
+    encoder_hidden_states: jax.Array | None = None
+    kv_caches: list[KVCache] | None = None
+
+
+def shift_right(labels: jax.Array, start_token_id: int, pad_token_id: int) -> jax.Array:
+    """Decoder inputs from targets (HF `shift_tokens_right`): prepend the start token, drop
+    the last target, and replace IGNORE_INDEX with pad so embeddings stay in-vocab."""
+    inputs = jnp.concatenate(
+        [jnp.full_like(labels[:, :1], start_token_id), labels[:, :-1]], axis=1
+    )
+    return jnp.where(inputs == IGNORE_INDEX, pad_token_id, inputs)
+
+
+class EncDecBlock(nn.Module):
+    """Decoder block: self-attention (causal, cacheable) -> cross-attention -> MLP, each
+    pre-normed with the µP residual multiplier applied like `modeling_utils.Block`."""
+
+    config: EncDecDolomiteConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        encoder_hidden_states: jax.Array,
+        encoder_attention_mask: jax.Array | None = None,
+        attention_mask: jax.Array | None = None,
+        rope_cos_sin: tuple[jax.Array, jax.Array] | None = None,
+        kv_cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        from .modeling_utils import MLP, Attention
+
+        config = self.config
+        m_residual = config.m_residual
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_1")(hidden_states)
+        attn_out, kv_cache = Attention(
+            config=config,
+            attention_implementation=self.attention_implementation,
+            dtype=self.dtype,
+            name="attn",
+        )(
+            h,
+            attention_mask=attention_mask,
+            rope_cos_sin=rope_cos_sin,
+            kv_cache=kv_cache,
+            cache_index=cache_index,
+            deterministic=deterministic,
+        )
+        if m_residual is not None:
+            attn_out = attn_out * m_residual
+        hidden_states = residual + attn_out
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_cross")(hidden_states)
+        cross_out = CrossAttention(config=config, dtype=self.dtype, name="cross_attn")(
+            h,
+            encoder_hidden_states,
+            encoder_attention_mask=encoder_attention_mask,
+            deterministic=deterministic,
+        )
+        if m_residual is not None:
+            cross_out = cross_out * m_residual
+        hidden_states = residual + cross_out
+
+        residual = hidden_states
+        h = get_norm(config, self.dtype, "ln_2")(hidden_states)
+        mlp_out = MLP(config=config, dtype=self.dtype, name="mlp")(h, deterministic=deterministic)
+        if m_residual is not None:
+            mlp_out = mlp_out * m_residual
+        hidden_states = residual + mlp_out
+
+        hidden_states = nn.with_logical_constraint(
+            hidden_states, ("act_batch", "act_seq", "act_embed")
+        )
+        return hidden_states, kv_cache
+
+
+class EncDecDolomiteForSeq2SeqLM(nn.Module):
+    config: EncDecDolomiteConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    checkpoint_every: int = 0
+    checkpoint_policy: str | None = None
+
+    def setup(self) -> None:
+        import dataclasses
+
+        config = self.config
+        self.wte = ParameterizedEmbedding(
+            num_embeddings=config.vocab_size,
+            features=config.n_embd,
+            std=config.initializer_range,
+            dtype=self.dtype,
+        )
+        self.drop = nn.Dropout(rate=config.embd_pdrop)
+
+        remat_policy = resolve_remat_policy(self.checkpoint_policy)
+
+        # depth-scaled init counts each stack's OWN residual branches: 2 per encoder block,
+        # 3 per decoder block (self-attn + cross-attn + MLP) — config.n_layer alone would
+        # mis-scale asymmetric encoder/decoder depths (modeling_utils.depth_scaled_init_std)
+        enc_config = dataclasses.replace(
+            config, init_residual_branches=2 * config.n_encoder_layer
+        )
+        dec_config = dataclasses.replace(config, init_residual_branches=3 * config.n_layer)
+
+        enc_blocks = []
+        for i in range(config.n_encoder_layer):
+            cls = Block
+            if self.checkpoint_every and i % self.checkpoint_every == 0:
+                # deterministic is positional arg 8 counting the module instance as 0
+                cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy)
+            enc_blocks.append(
+                cls(
+                    config=enc_config,
+                    attention_implementation=self.attention_implementation,
+                    dtype=self.dtype,
+                    causal=False,
+                )
+            )
+        self.encoder = enc_blocks
+        self.ln_enc = get_norm(config, self.dtype)
+
+        dec_blocks = []
+        for i in range(config.n_layer):
+            cls = EncDecBlock
+            if self.checkpoint_every and i % self.checkpoint_every == 0:
+                cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy)
+            dec_blocks.append(
+                cls(
+                    config=dec_config,
+                    attention_implementation=self.attention_implementation,
+                    dtype=self.dtype,
+                )
+            )
+        self.decoder = dec_blocks
+        self.ln_dec = get_norm(config, self.dtype)
+
+        self.rope_params = None
+        if PositionEmbeddingType(config.position_embedding_type) == PositionEmbeddingType.rope:
+            self.rope_params = RoPEParams.from_config(
+                config.head_dim,
+                base=config.rope_theta,
+                rope_scaling=config.rope_scaling,
+                max_position_embeddings=config.n_positions,
+            )
+
+    def encode(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        """Bidirectional encoder pass -> [B, S_enc, D] (post-norm)."""
+        config = self.config
+        batch, seq = input_ids.shape
+        hidden_states = self.wte(input_ids)
+        if config.m_emb is not None:
+            hidden_states = hidden_states * config.m_emb
+        hidden_states = self.drop(hidden_states, deterministic=deterministic)
+
+        position_ids = jnp.arange(seq)[None, :]
+        rope_cos_sin, _ = compute_position_stuff(
+            config, position_ids, self.rope_params, config.n_head, attention_mask, batch, seq,
+            self.dtype,
+        )
+        for block in self.encoder:
+            hidden_states, _ = block(
+                hidden_states,
+                attention_mask,
+                None,  # segment_ids
+                rope_cos_sin,
+                None,  # alibi
+                None,  # kv_cache
+                None,  # cache_index
+                deterministic,
+            )
+        return self.ln_enc(hidden_states)
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        decoder_input_ids: jax.Array | None = None,
+        labels: jax.Array | None = None,
+        encoder_hidden_states: jax.Array | None = None,
+        kv_caches: list[KVCache] | None = None,
+        cache_index: jax.Array | None = None,
+        deterministic: bool = True,
+        compute_loss: bool = False,
+    ) -> Seq2SeqOutput:
+        config = self.config
+
+        if encoder_hidden_states is None:
+            encoder_hidden_states = self.encode(
+                input_ids, attention_mask, deterministic=deterministic
+            )
+
+        if decoder_input_ids is None:
+            assert labels is not None, "need decoder_input_ids or labels"
+            decoder_input_ids = shift_right(
+                labels, config.decoder_start_token_id, config.pad_token_id or 0
+            )
+
+        batch, seq = decoder_input_ids.shape
+        hidden_states = self.wte(decoder_input_ids)
+        if config.m_emb is not None:
+            hidden_states = hidden_states * config.m_emb
+        hidden_states = self.drop(hidden_states, deterministic=deterministic)
+
+        offset = 0 if cache_index is None else cache_index
+        position_ids = jnp.arange(seq)[None, :] + offset
+        key_length = seq if kv_caches is None else kv_caches[0]["k"].shape[1]
+        rope_cos_sin, _ = compute_position_stuff(
+            config, position_ids, self.rope_params, config.n_head, None, batch, key_length,
+            self.dtype,
+        )
+
+        new_caches = [] if kv_caches is not None else None
+        for i, block in enumerate(self.decoder):
+            hidden_states, cache = block(
+                hidden_states,
+                encoder_hidden_states,
+                attention_mask,
+                None,  # decoder self-attention mask: causal handles it (right-padded labels
+                # only ever produce IGNORE_INDEX targets, so padded positions don't train)
+                rope_cos_sin,
+                None if kv_caches is None else kv_caches[i],
+                cache_index,
+                deterministic,
+            )
+            if new_caches is not None:
+                new_caches.append(cache)
+        hidden_states = self.ln_dec(hidden_states)
+
+        table = self.wte.embedding_table().astype(self.dtype)
+        logits = jnp.dot(hidden_states.astype(self.dtype), table.T)
+        logits = nn.with_logical_constraint(logits, ("act_batch", "act_seq", "act_vocab"))
+        if config.m_width is not None:
+            logits = logits / config.m_width
+
+        loss = None
+        if labels is not None and (compute_loss or kv_caches is None):
+            loss_sum, num_tokens = cross_entropy_loss(
+                logits, labels, upcast=config.upcast_logits_for_loss
+            )
+            loss = loss_sum / jnp.maximum(num_tokens, 1.0)
+
+        return Seq2SeqOutput(
+            logits=logits,
+            loss=loss,
+            encoder_hidden_states=encoder_hidden_states,
+            kv_caches=new_caches,
+        )
+
+    def init_kv_caches(self, batch_size: int, max_length: int, dtype=None) -> list[KVCache]:
+        config = self.config
+        dtype = dtype or self.dtype
+        shape = (batch_size, max_length, config.num_key_value_heads, config.head_dim)
+        return [
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(config.n_layer)
+        ]

@@ -185,6 +185,16 @@ def get_norm(config: CommonConfig, dtype: Dtype, name: str | None = None) -> Nor
     )
 
 
+def depth_scaled_init_std(config: CommonConfig) -> float:
+    """Residual-projection init std (GPT-2 depth scaling, reference gpt_dolomite init):
+    initializer_range / sqrt(total residual-branch count). Decoder-only families add 2
+    residual branches per layer (2*n_layer); stacks with a different count — e.g. the
+    enc-dec decoder's self-attn + cross-attn + MLP (3 per block), or an encoder whose depth
+    is n_encoder_layer — override via `init_residual_branches`."""
+    branches = getattr(config, "init_residual_branches", None) or 2 * config.n_layer
+    return config.initializer_range / math.sqrt(branches)
+
+
 def get_softmax_scale(config: CommonConfig, head_dim: int) -> float:
     """attention_multiplier if set, else 1/sqrt(head_dim) when scale_attn_weights, else 1
     (reference `attention/base.py` / `sdpa.py` scale selection)."""
@@ -258,7 +268,7 @@ class Attention(nn.Module):
             name="c_attn",
         )
 
-        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        std = depth_scaled_init_std(config)
         if init_method == InitMethod.mup:
             std /= math.sqrt(config.m_width)
         c_proj = ParameterizedLinear(
@@ -366,7 +376,7 @@ class MLP(nn.Module):
             name="c_fc",
         )
 
-        std = config.initializer_range / math.sqrt(2 * config.n_layer)
+        std = depth_scaled_init_std(config)
         if init_method == InitMethod.mup:
             std /= math.sqrt(config.m_width)
         c_proj = ParameterizedLinear(
@@ -387,13 +397,103 @@ class MLP(nn.Module):
         return h
 
 
+class CrossAttention(nn.Module):
+    """Encoder-decoder cross-attention: queries from the decoder stream, fused K/V from the
+    encoder output. No KV cache / RoPE — encoder K/V are static per sequence and positions
+    live in the self-attention sublayers. Runs sdpa: q_len != kv_len in general, so the
+    causal Pallas kernels don't apply, and cross shapes in finetuning are modest."""
+
+    config: CommonConfig
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        encoder_hidden_states: jax.Array,
+        encoder_attention_mask: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        config = self.config
+        num_heads = config.n_head
+        num_kv_heads = config.num_key_value_heads
+        head_dim = config.head_dim
+
+        init_method = InitMethod(config.init_method)
+        std = config.initializer_range
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_q = ParameterizedLinear(
+            features=num_heads * head_dim,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "heads"),
+            dtype=self.dtype,
+            name="c_q",
+        )
+        c_kv = ParameterizedLinear(
+            features=2 * num_kv_heads * head_dim,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("embed", "heads"),
+            dtype=self.dtype,
+            name="c_kv",
+        )
+
+        std = depth_scaled_init_std(config)
+        if init_method == InitMethod.mup:
+            std /= math.sqrt(config.m_width)
+        c_proj = ParameterizedLinear(
+            features=config.n_embd,
+            use_bias=config.add_bias,
+            std=std,
+            kernel_axes=("heads", "embed"),
+            dtype=self.dtype,
+            name="c_proj",
+        )
+
+        batch, q_seq = hidden_states.shape[:2]
+        kv_seq = encoder_hidden_states.shape[1]
+
+        query = c_q(hidden_states).reshape(batch, q_seq, num_heads, head_dim)
+        kv = c_kv(encoder_hidden_states)
+        key, value = jnp.split(kv, 2, axis=-1)
+        key = key.reshape(batch, kv_seq, num_kv_heads, head_dim)
+        value = value.reshape(batch, kv_seq, num_kv_heads, head_dim)
+
+        dropout_rng = None
+        attn_pdrop = 0.0 if deterministic else config.attn_pdrop
+        if attn_pdrop > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        out = attention_op(
+            query,
+            key,
+            value,
+            implementation=AttentionImplementation.sdpa,
+            causal=False,
+            softmax_scale=get_softmax_scale(config, head_dim),
+            attention_mask=encoder_attention_mask,
+            softmax_in_fp32=config.attention_softmax_in_fp32,
+            dropout=attn_pdrop,
+            dropout_rng=dropout_rng,
+        )
+
+        out = out.reshape(batch, q_seq, num_heads * head_dim)
+        out = c_proj(out)
+        out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)
+        return out
+
+
 class Block(nn.Module):
     """Pre-norm transformer block with µP residual multiplier
-    (reference `gpt_dolomite/layer.py:11-86`)."""
+    (reference `gpt_dolomite/layer.py:11-86`). `causal=False` turns it into a bidirectional
+    (encoder) block — attention_mask then marks valid key positions."""
 
     config: CommonConfig
     attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
     dtype: Dtype = jnp.float32
+    causal: bool = True
 
     @nn.compact
     def __call__(
@@ -415,6 +515,7 @@ class Block(nn.Module):
         attn_out, kv_cache = Attention(
             config=config,
             attention_implementation=self.attention_implementation,
+            causal=self.causal,
             dtype=self.dtype,
             name="attn",
         )(

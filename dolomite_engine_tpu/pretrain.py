@@ -78,8 +78,11 @@ def evaluate(
     experiments_tracker: ExperimentsTracker | None,
     eval_steps: int,
     eval_step_fn,
+    group_names: list | None = None,
 ) -> float | None:
-    """eval_steps batches from each val group (reference `pretrain.py:222-280`)."""
+    """eval_steps batches from each val group (reference `pretrain.py:222-280`); groups are
+    reported under their `*_weighted_split_paths` key names (reference `pretrain.py:96-98`),
+    falling back to the numeric index."""
     if not val_dataloaders or all(dl is None for dl in val_dataloaders):
         return None
 
@@ -98,13 +101,28 @@ def evaluate(
         if count == 0:
             continue
         group_loss = loss_sum / count
+        name = (
+            group_names[group_index]
+            if group_names and group_index < len(group_names)
+            else str(group_index)
+        )
         track_val_metrics(
             global_step,
             group_loss,
             experiments_tracker,
-            group_name=str(group_index) if len(val_dataloaders) > 1 else None,
+            group_name=name if len(val_dataloaders) > 1 else None,
         )
     return group_loss
+
+
+def get_group_names(args: TrainingArgs, key: str) -> list | None:
+    """Validation/test group names from the dataset's `val_weighted_split_paths` /
+    `test_weighted_split_paths` keys (reference `pretrain.py:96-98` derives report names
+    from the same structure: a list of single-key {group_name: entries} dicts)."""
+    paths = args.datasets[0].class_args.get(key) if args.datasets else None
+    if not paths:
+        return None
+    return [list(group.keys())[0] for group in paths if isinstance(group, dict) and group]
 
 
 def train(
@@ -176,9 +194,18 @@ def train(
     if jax_rng is None:
         jax_rng = jax.random.PRNGKey(args.random_args.seed)
 
+    val_group_names = get_group_names(args, "val_weighted_split_paths")
+
     if eval_during_training and starting_iteration == 0 and eval_steps:
         evaluate(
-            val_dataloaders, model, state, 0, experiments_tracker, eval_steps, eval_step_fn
+            val_dataloaders,
+            model,
+            state,
+            0,
+            experiments_tracker,
+            eval_steps,
+            eval_step_fn,
+            group_names=val_group_names,
         )
 
     # running mean folds EVERY step (reference `train_utils.py:130-141`): accumulate the
@@ -236,6 +263,7 @@ def train(
                 experiments_tracker,
                 eval_steps,
                 eval_step_fn,
+                group_names=val_group_names,
             )
 
         if global_step % save_interval == 0 or global_step == num_training_steps:
@@ -263,6 +291,7 @@ def train(
             None,
             eval_steps,
             eval_step_fn,
+            group_names=get_group_names(args, "test_weighted_split_paths"),
         )
         if test_loss is not None:
             if experiments_tracker is not None:
