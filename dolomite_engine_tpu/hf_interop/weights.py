@@ -85,6 +85,11 @@ def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndar
     params = _unbox(params)
     params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
 
+    # keyed to the one family whose param-tree layout these converters implement; other
+    # registered enc-dec families need their own converter
+    if config.model_type == "enc_dec_dolomite":
+        return _enc_dec_params_to_state_dict(config, params)
+
     sd: dict[str, np.ndarray] = {}
     t = params["transformer"]
 
@@ -110,9 +115,7 @@ def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndar
             qb, kb, vb = b[:nq], b[nq : nq + nkv], b[nq + nkv :]
             sd[p + "attn.c_attn.bias"] = interleave_qkv(qb, kb, vb, config)
 
-        sd[p + "attn.c_proj.weight"] = np.ascontiguousarray(h["attn"]["c_proj"]["kernel"].T)
-        if "bias" in h["attn"]["c_proj"]:
-            sd[p + "attn.c_proj.bias"] = h["attn"]["c_proj"]["bias"]
+        _linear_to_sd(sd, p + "attn.c_proj.", h["attn"]["c_proj"])
 
         if config.model_type == "moe_dolomite":
             # MoE block (reference sd names use "mlp."; moe_dolomite/moe/base.py): gate is a
@@ -131,12 +134,8 @@ def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndar
             if "bias" in moe["c_proj"]:
                 sd[p + "mlp.c_proj.bias"] = moe["c_proj"]["bias"]
         else:
-            sd[p + "mlp.c_fc.weight"] = np.ascontiguousarray(h["mlp"]["c_fc"]["kernel"].T)
-            if "bias" in h["mlp"]["c_fc"]:
-                sd[p + "mlp.c_fc.bias"] = h["mlp"]["c_fc"]["bias"]
-            sd[p + "mlp.c_proj.weight"] = np.ascontiguousarray(h["mlp"]["c_proj"]["kernel"].T)
-            if "bias" in h["mlp"]["c_proj"]:
-                sd[p + "mlp.c_proj.bias"] = h["mlp"]["c_proj"]["bias"]
+            _linear_to_sd(sd, p + "mlp.c_fc.", h["mlp"]["c_fc"])
+            _linear_to_sd(sd, p + "mlp.c_proj.", h["mlp"]["c_proj"])
 
     _norm_to_sd(sd, "transformer.ln_f.", t["ln_f"])
 
@@ -150,6 +149,102 @@ def _norm_to_sd(sd: dict, prefix: str, norm_params: dict) -> None:
     sd[prefix + "weight"] = norm_params["weight"]
     if "bias" in norm_params:
         sd[prefix + "bias"] = norm_params["bias"]
+
+
+def _linear_to_sd(sd: dict, prefix: str, linear_params: dict) -> None:
+    """flax kernel [in, out] -> torch-style [out, in]."""
+    sd[prefix + "weight"] = np.ascontiguousarray(linear_params["kernel"].T)
+    if "bias" in linear_params:
+        sd[prefix + "bias"] = linear_params["bias"]
+
+
+def _linear_from_sd(get_tensor, prefix: str, add_bias: bool) -> dict:
+    out = {"kernel": np.ascontiguousarray(get_tensor(prefix + "weight").T)}
+    if add_bias:
+        out["bias"] = get_tensor(prefix + "bias")
+    return out
+
+
+def _enc_dec_params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndarray]:
+    """enc_dec_dolomite flax params -> safetensors layout. No reference counterpart exists
+    (the reference uses stock HF seq2seq models), so the layout is this framework's own:
+    T5-style `shared`/`encoder.block.i`/`decoder.block.i` names with torch-style [out, in]
+    matrices and the framework's flat [Q|K|V] fused projection (no interleave — there is no
+    foreign checkpoint to match)."""
+    sd: dict[str, np.ndarray] = {"shared.weight": params["wte"]["embedding"]}
+
+    for i in range(config.n_encoder_layer):
+        b = params[f"encoder_{i}"]
+        p = f"encoder.block.{i}."
+        _norm_to_sd(sd, p + "ln_1.", b["ln_1"])
+        _norm_to_sd(sd, p + "ln_2.", b["ln_2"])
+        _linear_to_sd(sd, p + "attn.c_attn.", b["attn"]["c_attn"])
+        _linear_to_sd(sd, p + "attn.c_proj.", b["attn"]["c_proj"])
+        _linear_to_sd(sd, p + "mlp.c_fc.", b["mlp"]["c_fc"])
+        _linear_to_sd(sd, p + "mlp.c_proj.", b["mlp"]["c_proj"])
+    _norm_to_sd(sd, "encoder.final_layernorm.", params["ln_enc"])
+
+    for i in range(config.n_layer):
+        b = params[f"decoder_{i}"]
+        p = f"decoder.block.{i}."
+        _norm_to_sd(sd, p + "ln_1.", b["ln_1"])
+        _norm_to_sd(sd, p + "ln_cross.", b["ln_cross"])
+        _norm_to_sd(sd, p + "ln_2.", b["ln_2"])
+        _linear_to_sd(sd, p + "attn.c_attn.", b["attn"]["c_attn"])
+        _linear_to_sd(sd, p + "attn.c_proj.", b["attn"]["c_proj"])
+        _linear_to_sd(sd, p + "cross_attn.c_q.", b["cross_attn"]["c_q"])
+        _linear_to_sd(sd, p + "cross_attn.c_kv.", b["cross_attn"]["c_kv"])
+        _linear_to_sd(sd, p + "cross_attn.c_proj.", b["cross_attn"]["c_proj"])
+        _linear_to_sd(sd, p + "mlp.c_fc.", b["mlp"]["c_fc"])
+        _linear_to_sd(sd, p + "mlp.c_proj.", b["mlp"]["c_proj"])
+    _norm_to_sd(sd, "decoder.final_layernorm.", params["ln_dec"])
+
+    return sd
+
+
+def _enc_dec_state_dict_to_params(config: CommonConfig, get_tensor) -> dict:
+    bias = config.add_bias
+    params: dict = {"wte": {"embedding": get_tensor("shared.weight")}}
+
+    for i in range(config.n_encoder_layer):
+        p = f"encoder.block.{i}."
+        params[f"encoder_{i}"] = {
+            "ln_1": _norm_from_sd(get_tensor, p + "ln_1.", config),
+            "ln_2": _norm_from_sd(get_tensor, p + "ln_2.", config),
+            "attn": {
+                "c_attn": _linear_from_sd(get_tensor, p + "attn.c_attn.", bias),
+                "c_proj": _linear_from_sd(get_tensor, p + "attn.c_proj.", bias),
+            },
+            "mlp": {
+                "c_fc": _linear_from_sd(get_tensor, p + "mlp.c_fc.", bias),
+                "c_proj": _linear_from_sd(get_tensor, p + "mlp.c_proj.", bias),
+            },
+        }
+    params["ln_enc"] = _norm_from_sd(get_tensor, "encoder.final_layernorm.", config)
+
+    for i in range(config.n_layer):
+        p = f"decoder.block.{i}."
+        params[f"decoder_{i}"] = {
+            "ln_1": _norm_from_sd(get_tensor, p + "ln_1.", config),
+            "ln_cross": _norm_from_sd(get_tensor, p + "ln_cross.", config),
+            "ln_2": _norm_from_sd(get_tensor, p + "ln_2.", config),
+            "attn": {
+                "c_attn": _linear_from_sd(get_tensor, p + "attn.c_attn.", bias),
+                "c_proj": _linear_from_sd(get_tensor, p + "attn.c_proj.", bias),
+            },
+            "cross_attn": {
+                "c_q": _linear_from_sd(get_tensor, p + "cross_attn.c_q.", bias),
+                "c_kv": _linear_from_sd(get_tensor, p + "cross_attn.c_kv.", bias),
+                "c_proj": _linear_from_sd(get_tensor, p + "cross_attn.c_proj.", bias),
+            },
+            "mlp": {
+                "c_fc": _linear_from_sd(get_tensor, p + "mlp.c_fc.", bias),
+                "c_proj": _linear_from_sd(get_tensor, p + "mlp.c_proj.", bias),
+            },
+        }
+    params["ln_dec"] = _norm_from_sd(get_tensor, "decoder.final_layernorm.", config)
+
+    return params
 
 
 def state_dict_to_params(
@@ -170,6 +265,13 @@ def state_dict_to_params(
         manager = get_tensor
         get_tensor = manager.get_tensor
 
+    if config.model_type == "enc_dec_dolomite":
+        params = _enc_dec_state_dict_to_params(config, get_tensor)
+        params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+        if shardings is not None:
+            params = jax.tree.map(lambda x, s: jax.device_put(x, s), params, shardings)
+        return params
+
     params: dict = {"transformer": {}}
     t = params["transformer"]
 
@@ -187,16 +289,12 @@ def state_dict_to_params(
 
         q, k, v = split_qkv(get_tensor(p + "attn.c_attn.weight"), config)
         kernel = np.ascontiguousarray(np.concatenate([q, k, v]).T)
-        h["attn"] = {"c_attn": {"kernel": kernel}, "c_proj": {}}
+        h["attn"] = {"c_attn": {"kernel": kernel}}
         if config.add_bias:
             qb, kb, vb = split_qkv(get_tensor(p + "attn.c_attn.bias"), config)
             h["attn"]["c_attn"]["bias"] = np.concatenate([qb, kb, vb])
 
-        h["attn"]["c_proj"]["kernel"] = np.ascontiguousarray(
-            get_tensor(p + "attn.c_proj.weight").T
-        )
-        if config.add_bias:
-            h["attn"]["c_proj"]["bias"] = get_tensor(p + "attn.c_proj.bias")
+        h["attn"]["c_proj"] = _linear_from_sd(get_tensor, p + "attn.c_proj.", config.add_bias)
 
         if config.model_type == "moe_dolomite":
             h["moe"] = {
@@ -217,12 +315,9 @@ def state_dict_to_params(
                 h["moe"]["c_proj"]["bias"] = get_tensor(p + "mlp.c_proj.bias")
         else:
             h["mlp"] = {
-                "c_fc": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_fc.weight").T)},
-                "c_proj": {"kernel": np.ascontiguousarray(get_tensor(p + "mlp.c_proj.weight").T)},
+                "c_fc": _linear_from_sd(get_tensor, p + "mlp.c_fc.", config.add_bias),
+                "c_proj": _linear_from_sd(get_tensor, p + "mlp.c_proj.", config.add_bias),
             }
-            if config.add_bias:
-                h["mlp"]["c_fc"]["bias"] = get_tensor(p + "mlp.c_fc.bias")
-                h["mlp"]["c_proj"]["bias"] = get_tensor(p + "mlp.c_proj.bias")
 
     t["ln_f"] = _norm_from_sd(get_tensor, "transformer.ln_f.", config)
 

@@ -245,3 +245,34 @@ def test_seq2seq_generation_shapes():
         if n < 6:
             assert row[n - 1] == config.eos_token_id
             assert (row[n:] == config.pad_token_id).all()
+
+
+@pytest.mark.parametrize(
+    "add_bias,normalization", [(False, "rmsnorm"), (True, "layernorm")]
+)
+def test_save_pretrained_roundtrip(tmp_path, add_bias, normalization):
+    """save_pretrained -> safetensors -> load_pretrained_params reproduces identical logits
+    (the family's own flat-QKV layout; no foreign checkpoint to match). Parametrized so the
+    bias + layernorm-bias converter branches are exercised, not just the bias-free path."""
+    from dolomite_engine_tpu.hf_interop.weights import (
+        params_to_state_dict,
+        state_dict_to_params,
+    )
+    from dolomite_engine_tpu.utils.safetensors import SafeTensorsWeightsManager
+
+    config = _config(add_bias=add_bias, normalization_function=normalization)
+    model = EncDecDolomiteForSeq2SeqLM(config=config)
+    input_ids, attention_mask, labels = _batch(seed=6)
+    params = model.init(
+        jax.random.PRNGKey(0), input_ids, attention_mask=attention_mask, labels=labels
+    )["params"]
+
+    sd = params_to_state_dict(config, params)
+    SafeTensorsWeightsManager.save_state_dict(sd, str(tmp_path))
+    loaded = state_dict_to_params(config, SafeTensorsWeightsManager(str(tmp_path)))
+
+    ref = model.apply({"params": params}, input_ids, attention_mask=attention_mask,
+                      labels=labels)
+    out = model.apply({"params": loaded}, input_ids, attention_mask=attention_mask,
+                      labels=labels)
+    np.testing.assert_allclose(np.asarray(out.logits), np.asarray(ref.logits), atol=1e-6)
