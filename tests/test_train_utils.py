@@ -65,3 +65,22 @@ def test_checkpointing_adds_recompute_fraction():
     )
     fwd = l * (attn + mlp)
     assert got == (3 * fwd + 0.5 * fwd + lm_head) / 1e12
+
+
+def test_val_group_names_from_weighted_split_paths():
+    """Named validation groups (reference pretrain.py:96-98): report names come from the
+    val_weighted_split_paths group keys; absent structure -> None (numeric fallback)."""
+    from types import SimpleNamespace
+
+    from dolomite_engine_tpu.pretrain import get_group_names
+
+    paths = [
+        {"books": [{"path": "p1", "split": "98,1,1", "weight": 1.0}]},
+        {"web": [{"path": "p2", "split": "98,1,1", "weight": 1.0}]},
+    ]
+    args = SimpleNamespace(
+        datasets=[SimpleNamespace(class_args={"val_weighted_split_paths": paths})]
+    )
+    assert get_group_names(args, "val_weighted_split_paths") == ["books", "web"]
+    assert get_group_names(args, "test_weighted_split_paths") is None
+    assert get_group_names(SimpleNamespace(datasets=[]), "val_weighted_split_paths") is None
