@@ -86,6 +86,11 @@ class ModelArgs(BaseArgs):
     # MoE compute path: scattermoe/scatter (ragged grouped GEMM), eager, auto
     # (reference configs/testing/scattermoe.yml)
     moe_implementation: str | None = None
+    # TPU extension (no reference counterpart): nn.scan over one transformer block instead
+    # of unrolling n_layer copies — ~n_layer-fold faster trace+compile for deep models.
+    # gpt_dolomite training only; with gradient checkpointing EVERY block remats
+    # (every-k-th is not expressible under one scanned layer)
+    scan_layers: bool = False
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None([(self.model_class, "model_class")])

@@ -90,6 +90,13 @@ def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndar
     if config.model_type == "enc_dec_dolomite":
         return _enc_dec_params_to_state_dict(config, params)
 
+    if "transformer" in params and "h_scan" in params["transformer"]:
+        # scan_layers checkpoint: split the stacked [n_layer, ...] block params back into
+        # per-layer subtrees so the export layout is identical to the unrolled model's
+        from ..models.gpt_dolomite import unstack_block_params
+
+        params = unstack_block_params(params, config.n_layer)
+
     sd: dict[str, np.ndarray] = {}
     t = params["transformer"]
 

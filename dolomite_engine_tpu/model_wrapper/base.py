@@ -92,6 +92,13 @@ class ModelWrapper:
                 "AutoModelForSeq2SeqLM, decoder-only families AutoModelForCausalLM"
             )
 
+        if self.model_kwargs.get("scan_layers") and self.model_type != "gpt_dolomite":
+            raise ValueError(
+                f"scan_layers supports gpt_dolomite only (got '{self.model_type}'): MoE "
+                "extras, per-group crosslayer, pattern-mixed RNN and enc-dec blocks cannot "
+                "ride one homogeneous scan"
+            )
+
         self._setup_tokenizer(tokenizer_name, additional_special_tokens)
 
         checkpoint_every = 0
@@ -273,6 +280,15 @@ class ModelWrapper:
         from ..utils.safetensors import SafeTensorsWeightsManager
 
         manager = SafeTensorsWeightsManager(path)
+        if self.model_kwargs.get("scan_layers"):
+            # checkpoints are stored unrolled (export unstacks); stack on load so the tree
+            # matches the scanned model's shardings — symmetric with params_to_state_dict
+            from ..models.gpt_dolomite import stack_block_params
+
+            params = stack_block_params(
+                state_dict_to_params(self.config, manager), self.config.n_layer
+            )
+            return jax.tree.map(jax.device_put, params, self.param_shardings(mesh))
         return state_dict_to_params(self.config, manager, mesh, self.param_shardings(mesh))
 
     # ------------------------------------------------------------------ forward
@@ -295,6 +311,10 @@ class ModelWrapper:
         """
         from ..generation_utils import make_generate_fn
 
+        assert not self.model_kwargs.get("scan_layers"), (
+            "generation requires the unrolled model: convert the checkpoint with "
+            "models.gpt_dolomite.unstack_block_params and rebuild without scan_layers"
+        )
         assert self.tokenizer is not None, "generation requires a tokenizer"
         if rng is None:
             rng = jax.random.PRNGKey(0)

@@ -163,6 +163,8 @@ def get_model(args, mode: Mode):
         model_kwargs["moe_implementation"] = normalize_moe_implementation(
             args.model_args.moe_implementation
         )
+    if args.model_args.scan_layers:
+        model_kwargs["scan_layers"] = True
 
     common = dict(
         mode=mode,

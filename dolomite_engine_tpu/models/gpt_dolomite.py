@@ -78,6 +78,13 @@ class GPTDolomiteModel(nn.Module):
     checkpoint_every: int = 0  # 0 = no remat; k = remat every k-th block
     checkpoint_policy: str | None = None  # jax.checkpoint_policies name (see resolve_remat_policy)
     block_cls: type = Block
+    # nn.scan over ONE block instead of unrolling n_layer copies: XLA traces and compiles a
+    # single layer, cutting trace+compile time ~n_layer-fold for deep models (pod-scale
+    # compiles and the multichip dryrun). TPU-native feature with no reference counterpart
+    # (torch.compile re-traces every block). Training path only: params carry a leading
+    # [n_layer] axis ("layers" logical name, replicated) — use stack_block_params /
+    # unstack_block_params to convert to/from the unrolled layout for generation or export.
+    scan_layers: bool = False
 
     def setup(self) -> None:
         config = self.config
@@ -98,15 +105,55 @@ class GPTDolomiteModel(nn.Module):
             )
         self.drop = nn.Dropout(rate=config.embd_pdrop)
 
-        blocks = []
         remat_policy = resolve_remat_policy(self.checkpoint_policy)
-        for i in range(self.num_blocks):
+        if self.scan_layers:
+            from ..ops.fp8 import fp8_enabled
+
+            assert self.block_cls is Block, (
+                "scan_layers supports homogeneous gpt_dolomite blocks only (MoE extras, "
+                "per-group crosslayer and pattern-mixed RNN blocks cannot ride one scan)"
+            )
+            assert not fp8_enabled(), (
+                "scan_layers with fp8 delayed-scaling state is not supported"
+            )
             cls = self.block_cls
-            if self.checkpoint_every and i % self.checkpoint_every == 0:
-                # flax counts the module instance as argument 0; deterministic is arg 8
+            if self.checkpoint_every:
+                # scan granularity is per-layer: every block remats, not every k-th
+                if self.checkpoint_every > 1:
+                    import logging
+
+                    from ..utils import log_rank_0
+
+                    log_rank_0(
+                        logging.WARNING,
+                        f"scan_layers remats EVERY block; checkpoint_every="
+                        f"{self.checkpoint_every} (every-k-th) is not expressible under "
+                        "one scanned layer — expect the full-remat memory/compute tradeoff",
+                    )
                 cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy)
-            blocks.append(self._make_block(cls, i))
-        self.h = blocks
+            self.h_scan = nn.scan(
+                cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,) * 7,
+                length=self.num_blocks,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(
+                config=config,
+                attention_implementation=self.attention_implementation,
+                dtype=self.dtype,
+            )
+        else:
+            blocks = []
+            for i in range(self.num_blocks):
+                cls = self.block_cls
+                if self.checkpoint_every and i % self.checkpoint_every == 0:
+                    # flax counts the module instance as argument 0; deterministic is arg 8
+                    cls = nn.remat(
+                        cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy
+                    )
+                blocks.append(self._make_block(cls, i))
+            self.h = blocks
 
         self.ln_f = get_norm(config, self.dtype)
 
@@ -181,6 +228,23 @@ class GPTDolomiteModel(nn.Module):
             self.dtype,
         )
 
+        if self.scan_layers:
+            assert kv_caches is None, (
+                "scan_layers is a training-path feature; for generation convert the "
+                "checkpoint with unstack_block_params and rebuild without scan_layers"
+            )
+            hidden_states, _ = self.h_scan(
+                hidden_states,
+                attention_mask,
+                segment_ids,
+                rope_cos_sin,
+                alibi_bias,
+                None,
+                None,
+                deterministic,
+            )
+            return self.ln_f(hidden_states), None, []
+
         new_caches = [] if kv_caches is not None else None
         extras = []  # per-block extra outputs (MoE router logits etc.)
         for i, block in enumerate(self.h):
@@ -204,12 +268,35 @@ class GPTDolomiteModel(nn.Module):
         return hidden_states, new_caches, extras
 
 
+def stack_block_params(params: dict, n_layer: int) -> dict:
+    """Unrolled `transformer.h_0..h_{L-1}` -> scanned `transformer.h_scan` with a leading
+    [n_layer] axis (the layout `scan_layers=True` models expect). Operates on (and returns)
+    unboxed trees — runtime param trees are unboxed by design; boxed inputs are unboxed."""
+    params = nn.unbox(params)
+    t = dict(params["transformer"])
+    blocks = [t.pop(f"h_{i}") for i in range(n_layer)]
+    t["h_scan"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return {**params, "transformer": t}
+
+
+def unstack_block_params(params: dict, n_layer: int) -> dict:
+    """Inverse of `stack_block_params`: split `transformer.h_scan` back into per-layer
+    subtrees (for generation, export, or loading into an unrolled model)."""
+    params = nn.unbox(params)
+    t = dict(params["transformer"])
+    stacked = t.pop("h_scan")
+    for i in range(n_layer):
+        t[f"h_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return {**params, "transformer": t}
+
+
 class GPTDolomiteForCausalLM(nn.Module):
     config: CommonConfig
     attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
     dtype: Any = jnp.float32
     checkpoint_every: int = 0
     checkpoint_policy: str | None = None
+    scan_layers: bool = False
     base_model_cls: type = GPTDolomiteModel
 
     def _transformer_kwargs(self) -> dict:
@@ -220,6 +307,7 @@ class GPTDolomiteForCausalLM(nn.Module):
             dtype=self.dtype,
             checkpoint_every=self.checkpoint_every,
             checkpoint_policy=self.checkpoint_policy,
+            scan_layers=self.scan_layers,
         )
 
     def setup(self) -> None:

@@ -52,6 +52,7 @@ def get_logical_axis_rules(
 
     rules: LogicalRules = [
         # parameter axes
+        ("layers", None),  # scan_layers stacked-block axis: replicated, shard within layers
         ("vocab", "tp" if tensor_parallel_word_embeddings else fsdp),
         ("embed", fsdp),
         ("heads", "tp"),

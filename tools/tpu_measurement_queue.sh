@@ -8,7 +8,8 @@ cd /root/repo
 
 SW="timeout 900 python tools/bench_sweep.py"
 
-for i in $(seq 1 200); do
+# 400 probes x ~2min ~= 13h of patience: observed backend outages have run 10h+
+for i in $(seq 1 400); do
   if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
     echo "=== TPU recovered at $(date)"
 
