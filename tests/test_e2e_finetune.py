@@ -29,12 +29,12 @@ class _StubTokenizer:
         pass
 
 
-def _training_args(tmp_path, num_steps=3, load_path=None) -> TrainingArgs:
+def _training_args(tmp_path, num_steps=3, load_path=None, seq2seq=False) -> TrainingArgs:
     cfg = dict(
         model_args=dict(
-            model_class="AutoModelForCausalLM",
+            model_class="AutoModelForSeq2SeqLM" if seq2seq else "AutoModelForCausalLM",
             pretrained_config=dict(
-                model_type="gpt_dolomite",
+                model_type="enc_dec_dolomite" if seq2seq else "gpt_dolomite",
                 vocab_size=128,
                 n_positions=64,
                 n_embd=32,
@@ -130,3 +130,37 @@ def test_finetune_save_resume_unshard(tmp_path, stub_tokenizer, eight_devices):
 
     manager = SafeTensorsWeightsManager(str(tmp_path / "unsharded"))
     assert manager.has_tensor("transformer.wte.weight")
+
+
+def test_seq2seq_finetune_save_resume_unshard(tmp_path, stub_tokenizer, eight_devices):
+    """Same lifecycle through the encoder-decoder family: finetune -> orbax checkpoint ->
+    resume -> unshard to the family's safetensors layout."""
+    from dolomite_engine_tpu import finetune, unshard
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    MeshManager.destroy()
+    args = _training_args(tmp_path, num_steps=3, seq2seq=True)
+    finetune.main(args=args)
+
+    ckpt_root = tmp_path / "ckpt"
+    latest = ckpt_root / "latest_checkpointed_iteration.json"
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 3
+
+    MeshManager.destroy()
+    args2 = _training_args(tmp_path, num_steps=5, load_path=str(ckpt_root), seq2seq=True)
+    finetune.main(args=args2)
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 5
+
+    MeshManager.destroy()
+    unshard_args = UnshardingArgs(
+        load_args=dict(load_path=str(ckpt_root)),
+        unsharded_path=str(tmp_path / "unsharded"),
+    )
+    unshard.main(args=unshard_args)
+    from dolomite_engine_tpu.utils.safetensors import SafeTensorsWeightsManager
+
+    manager = SafeTensorsWeightsManager(str(tmp_path / "unsharded"))
+    assert manager.has_tensor("shared.weight")
+    assert manager.has_tensor("decoder.block.0.cross_attn.c_q.weight")

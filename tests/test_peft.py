@@ -108,3 +108,41 @@ def test_prompt_tuning_forward_and_mask():
         jax.tree_util.keystr(p) for p, v in jax.tree_util.tree_leaves_with_path(tmask) if v
     ]
     assert trainable and all("prompt_embeddings" in p for p in trainable)
+
+
+def test_lora_on_seq2seq_family():
+    """LoRA composes with enc_dec_dolomite (reference PEFTs any HF model incl. seq2seq):
+    adapters appear in BOTH stacks' targeted linears, zero-init preserves outputs, and the
+    trainable mask freezes every base weight."""
+    from dolomite_engine_tpu.models.config import EncDecDolomiteConfig
+    from dolomite_engine_tpu.models.enc_dec_dolomite import EncDecDolomiteForSeq2SeqLM
+    from dolomite_engine_tpu.ops.loss import IGNORE_INDEX
+
+    config = EncDecDolomiteConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_encoder_layer=2,
+        n_head=4, num_key_value_heads=2, attention_head_type="gqa",
+        position_embedding_type="rope", activation_function="swiglu",
+        normalization_function="rmsnorm", add_bias=False,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        bos_token_id=0, eos_token_id=1, pad_token_id=2,
+    )
+    base = EncDecDolomiteForSeq2SeqLM(config=config)
+    lora = LoRACausalLM(base_model=base, rank=4, alpha=8.0, dropout=0.0)
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(3, 128, size=(2, 16)), jnp.int32)
+    labels = jnp.asarray(rs.randint(3, 128, size=(2, 8)), jnp.int32)
+    lora_vars = lora.init(jax.random.PRNGKey(0), ids, labels=labels)
+
+    p = lora_vars["params"]["base_model"]
+    assert "lora_a" in p["encoder_0"]["attn"]["c_attn"]
+    assert "lora_a" in p["decoder_0"]["attn"]["c_attn"]
+
+    out = lora.apply(lora_vars, ids, labels=labels)
+    assert np.isfinite(float(out.loss))
+
+    mask = peft_trainable_mask(lora_vars["params"])
+    leaves = jax.tree_util.tree_leaves_with_path(mask)
+    trainable = [jax.tree_util.keystr(pth) for pth, v in leaves if v]
+    # c_attn in 2 encoder + 2 decoder blocks, a+b each
+    assert len(trainable) == 8 and all("lora" in t for t in trainable)
