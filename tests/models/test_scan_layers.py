@@ -222,3 +222,34 @@ def test_scan_wrapper_guards_and_load_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
     finally:
         MeshManager.destroy()
+
+
+def test_scan_composes_with_ring_cp(eight_devices):
+    """scan-of-shard_map: scanned blocks with ring context parallelism (sp=2) compile and
+    match the unscanned ring model on the same weights."""
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    config = _config(n_layer=2)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 256, size=(2, 32)), jnp.int32)
+
+    MeshManager(sequence_parallel_size=2, data_parallel_sharding_world_size=4)
+    mesh = MeshManager.get_mesh()
+    try:
+        with mesh:
+            unrolled = GPTDolomiteForCausalLM(
+                config=config, attention_implementation=AttentionImplementation.ring
+            )
+            params = unrolled.init(jax.random.PRNGKey(0), ids)["params"]
+            ref = unrolled.apply({"params": params}, ids).logits
+
+            scanned = GPTDolomiteForCausalLM(
+                config=config,
+                attention_implementation=AttentionImplementation.ring,
+                scan_layers=True,
+            )
+            out = scanned.apply(
+                {"params": stack_block_params(params, config.n_layer)}, ids
+            ).logits
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    finally:
+        MeshManager.destroy()
