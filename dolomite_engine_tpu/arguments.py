@@ -50,27 +50,27 @@ class PromptTuningInit(Enum):
 
 
 class RandomArgs(BaseArgs):
-    # random seed
+    # seed for all RNG streams (python/numpy/jax)
     seed: int = 42
 
 
 class TokenizerArgs(BaseArgs):
-    # override model's tokenizer with this
+    # tokenizer path/hub id taking precedence over the model's own
     tokenizer_name: str | None = None
-    # add special tokens to the tokenizer
+    # extra special tokens appended to the tokenizer (may grow the embedding)
     additional_special_tokens: list[str] | None = None
 
 
 class ModelArgs(BaseArgs):
-    # model name on huggingface hub (or local path)
+    # HF hub id or local checkpoint dir to load
     model_name: str | None = None
-    # config dict to build the model from scratch
+    # inline config dict for from-scratch construction (mutually exclusive with model_name)
     pretrained_config: dict | None = None
     # model family class: AutoModelForCausalLM / AutoModelForSeq2SeqLM
     model_class: str = None
     # trust remote code (accepted for config compat; unused by the JAX registry)
     trust_remote_code: bool = False
-    # attention implementation
+    # attention backend: eager/sdpa/flash_attention_2 (+ ring/ulysses CP extensions)
     attention_implementation: AttentionImplementation | None = None
     # padding-free transformer: packed sequences + segment-ids attention
     use_padding_free_transformer: bool = False
@@ -112,11 +112,11 @@ class ModelArgs(BaseArgs):
 
 
 class PromptTuningArgs(BaseArgs):
-    # prompt tuning init method
+    # how prompt-tuning virtual tokens initialize (random or from text)
     prompt_tuning_init: PromptTuningInit = None
-    # prompt tuning init text
+    # seed text whose token embeddings initialize the virtual tokens
     prompt_tuning_init_text: str | None = None
-    # number of virtual tokens for PEFT
+    # virtual-token count prepended by prompt tuning
     num_virtual_tokens: int | None = None
 
     def model_post_init(self, __context: Any) -> None:
@@ -134,11 +134,11 @@ class PromptTuningArgs(BaseArgs):
 
 
 class LoRAArgs(BaseArgs):
-    # lora rank
+    # rank of the LoRA update matrices
     lora_rank: int = None
-    # the scaling factor for the low-rank matrices
+    # LoRA alpha: update scaled by alpha/rank
     lora_alpha: float = 32.0
-    # the dropout probability of the LoRA layers
+    # dropout applied to LoRA adapter inputs
     lora_dropout: float = 0.1
 
     def model_post_init(self, __context: Any) -> None:
@@ -146,11 +146,11 @@ class LoRAArgs(BaseArgs):
 
 
 class TuningArgs(BaseArgs):
-    # type of tuning, full finetuning or PEFT
+    # training regime: pretraining, full_finetuning, lora, prompt_tuning
     tuning_method: TuningMethod = None
-    # prompt tuning related arguments
+    # knobs for prompt tuning (used when tuning_method selects it)
     prompt_tuning_args: PromptTuningArgs | None = None
-    # lora related arguments
+    # knobs for LoRA (used when tuning_method selects it)
     lora_args: LoRAArgs | None = None
 
     def model_post_init(self, __context: Any) -> None:
@@ -179,21 +179,21 @@ class TuningArgs(BaseArgs):
 
 
 class TrainingParameters(BaseArgs):
-    # whether to use sequential sampler for validation
+    # validation iterates in corpus order instead of shuffling
     ignore_sampling_proportion_for_validation: bool = False
-    # number of training steps
+    # total optimizer steps to run
     num_training_steps: int | None = None
-    # gradient accumulation steps
+    # micro-steps folded into one optimizer step (lax.scan in the jitted step)
     gradient_accumulation_steps: int = 1
-    # interval for evaluation
+    # evaluate every this many steps
     eval_interval: int | None = None
-    # batch size per device for ZeRO-DP
+    # per-data-parallel-replica micro batch size
     micro_batch_size: int = None
-    # whether to use val dataset for validation during training
+    # run in-loop validation
     eval_during_training: bool = True
-    # masking methodology of loss function input
+    # which tokens contribute loss (output_only masks the prompt)
     loss_mask: LossMask = LossMask.output_only
-    # gradient clip value
+    # global-norm gradient clipping threshold
     gradient_clipping: float | None = 1
 
     def model_post_init(self, __context: Any) -> None:
@@ -209,11 +209,11 @@ class TrainingParameters(BaseArgs):
 
 
 class SaveArgs(BaseArgs):
-    # path to save checkpoints
+    # checkpoint output directory
     save_path: str = None
-    # interval for checkpointing
+    # save every this many steps
     save_interval: int = None
-    # whether to save optimizer
+    # include optimizer state in checkpoints (skip to shrink them)
     save_optimizer: bool = True
     # overlap checkpoint disk writes with training (TPU-native extension, not in the
     # reference): the device->host copy is synchronous, the serialization+write runs in a
