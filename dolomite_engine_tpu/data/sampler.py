@@ -68,21 +68,17 @@ class BlendedDistributedSampler:
     def _get_indices_in_data_subset(
         self, num_samples_in_subset: int, subset_size: int, rng: np.random.Generator
     ) -> np.ndarray:
-        if num_samples_in_subset < subset_size:
-            if self.shuffle:
-                return rng.permutation(subset_size)[:num_samples_in_subset]
-            return np.arange(num_samples_in_subset)
-
-        num_concats = num_samples_in_subset // subset_size
-        padding = num_samples_in_subset - num_concats * subset_size
-        sampler = np.tile(np.arange(subset_size), num_concats)
-        if padding > 0:
-            if self.shuffle:
-                pad = rng.permutation(subset_size)[:padding]
-            else:
-                pad = np.arange(padding)
-            sampler = np.concatenate([sampler, pad])
-        return sampler
+        """Over/undersample one subset to its quota: whole-epoch tiles plus a partial draw
+        (shuffled = a permutation prefix, consuming exactly one rng call iff needed)."""
+        if self.shuffle:
+            draw = lambda k: rng.permutation(subset_size)[:k]  # noqa: E731
+        else:
+            draw = np.arange
+        full_epochs, remainder = divmod(num_samples_in_subset, subset_size)
+        if full_epochs == 0:
+            return draw(num_samples_in_subset)
+        tiles = np.tile(np.arange(subset_size), full_epochs)
+        return np.concatenate([tiles, draw(remainder)]) if remainder else tiles
 
     def __iter__(self) -> Iterator[int]:
         rng = self._rng()
@@ -103,12 +99,10 @@ class BlendedDistributedSampler:
         if self.drop_last:
             indices = indices[: self.total_size]
         else:
-            padding_size = self.total_size - len(indices)
-            if padding_size > 0:
-                if padding_size <= len(indices):
-                    indices += indices[:padding_size]
-                else:
-                    indices += (indices * math.ceil(padding_size / len(indices)))[:padding_size]
+            shortfall = self.total_size - len(indices)
+            if shortfall > 0:
+                # wrap-around pad to a replica multiple (may cycle the list several times)
+                indices += (indices * math.ceil(shortfall / len(indices)))[:shortfall]
 
         assert len(indices) == self.total_size
 
