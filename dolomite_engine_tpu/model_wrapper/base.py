@@ -187,6 +187,23 @@ class ModelWrapper:
 
         return fp8_scope(self.use_fp8)
 
+    def apply_scope(self):
+        """Context for every model trace: fp8 mode + this wrapper's logical-axis rules.
+
+        The rules binding is what makes the `nn.with_logical_constraint` calls inside the
+        models/ops actually resolve to mesh axes — without an ambient rules context flax
+        silently drops them and the partitioner is left to propagate shardings on its own
+        (which is how the MoE fused-CE backward ended up replicating a logits-sized tensor
+        under an ep mesh). Under no global mesh (single-chip tests, generation) the bound
+        constraints remain no-ops, so this only affects mesh-scoped programs.
+        """
+        import contextlib
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(self.fp8_scope())
+        stack.enter_context(nn.logical_axis_rules(self.sharding_rules()))
+        return stack
+
     def variables(self, params, fp8_state=None) -> dict:
         """Assemble the apply() variable dict; fp8 delayed-scaling state rides its own
         collection (ops/fp8.py OWG_COLLECTION)."""
@@ -259,7 +276,7 @@ class ModelWrapper:
         def _init():
             return nn.unbox(self.model.init(rng, **self.get_dummy_inputs())["params"])
 
-        with mesh, self.fp8_scope():
+        with mesh, self.apply_scope():
             return jax.jit(_init, out_shardings=shardings)()
 
     # ------------------------------------------------------------------ io
@@ -283,22 +300,26 @@ class ModelWrapper:
         if self.model_kwargs.get("scan_layers"):
             # checkpoints are stored unrolled (export unstacks); stack on load so the tree
             # matches the scanned model's shardings — symmetric with params_to_state_dict
-            from ..models.gpt_dolomite import stack_block_params
+            from ..models.gpt_dolomite import scan_group_size, stack_block_params
 
             params = stack_block_params(
-                state_dict_to_params(self.config, manager), self.config.n_layer
+                state_dict_to_params(self.config, manager),
+                self.config.n_layer,
+                # every-k remat under scan groups k blocks per scan step (BlockGroup layout)
+                group_size=scan_group_size(self.config.n_layer, self.checkpoint_every),
             )
             return jax.tree.map(jax.device_put, params, self.param_shardings(mesh))
         return state_dict_to_params(self.config, manager, mesh, self.param_shardings(mesh))
 
     # ------------------------------------------------------------------ forward
     def __call__(self, params, batch: dict, rngs: dict | None = None, train: bool = False):
-        return self.model.apply(
-            {"params": params},
-            deterministic=not train,
-            rngs=rngs,
-            **batch,
-        )
+        with self.apply_scope():
+            return self.model.apply(
+                {"params": params},
+                deterministic=not train,
+                rngs=rngs,
+                **batch,
+            )
 
     def generate(
         self, params: Any, batch: dict, generate_kwargs: dict, rng: jax.Array | None = None
@@ -330,7 +351,9 @@ class ModelWrapper:
             top_k=generate_kwargs.get("top_k"),
             top_p=None if top_p is None else float(top_p),
             eos_token_id=self.eos_token_id,
-            pad_token_id=self.tokenizer.pad_token_id or self.eos_token_id or 0,
+            pad_token_id=next(
+                (t for t in (self.tokenizer.pad_token_id, self.eos_token_id) if t is not None), 0
+            ),
         )
         if self.is_encoder_decoder:
             static["decoder_start_token_id"] = self.config.decoder_start_token_id

@@ -94,7 +94,7 @@ class ModelWrapperForPretraining(ModelWrapper):
     ):
         """Scalar LM loss (+ MoE aux loss folded in when the model emits one)."""
         batch = self.prepare_inputs_and_labels(text)
-        with self.fp8_scope():
+        with self.apply_scope():
             output = self.model.apply(
                 self.variables(params, fp8_state),
                 deterministic=not train,
@@ -137,7 +137,7 @@ class ModelWrapperForFinetuning(ModelWrapper):
             # added to input embeddings; implemented via the models' embedding_noise rng hook.
             rngs = dict(rngs or {})
             rngs.setdefault("neft", jax.random.PRNGKey(0))
-        with self.fp8_scope():
+        with self.apply_scope():
             output = self.model.apply(
                 self.variables(params, fp8_state),
                 deterministic=not train,

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.sharding import logical_constraint
+
 from ..enums import AttentionImplementation
 from ..ops.attention import attention as attention_op
 from ..ops.rope import apply_rotary_pos_emb
@@ -236,7 +238,7 @@ class CrossLayerGroup(nn.Module):
                 mlp_out = mlp_out * m_residual
             hidden_states = residual + mlp_out
 
-        hidden_states = nn.with_logical_constraint(
+        hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
         return hidden_states, kv_cache

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.sharding import logical_constraint
+
 from ..enums import AttentionImplementation, normalize_moe_implementation
 from ..ops.activations import get_activation_function, is_glu
 from ..ops.moe import (
@@ -297,7 +299,7 @@ class SparseMoEBlock(nn.Module):
             moe_out = moe_out * m_residual
         hidden_states = residual + moe_out
 
-        hidden_states = nn.with_logical_constraint(
+        hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
         return hidden_states, kv_cache, router_logits

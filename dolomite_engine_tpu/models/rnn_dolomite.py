@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.sharding import logical_constraint
+
 from ..enums import AttentionImplementation
 from ..ops.deltanet import (
     delta_rule_chunked,
@@ -250,7 +252,7 @@ class RNNDolomiteBlock(nn.Module):
             mlp_out = mlp_out * m_residual
         hidden_states = residual + mlp_out
 
-        hidden_states = nn.with_logical_constraint(
+        hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
         return hidden_states, kv_cache
@@ -278,7 +280,7 @@ class RNNDolomiteForCausalLM(GPTDolomiteForCausalLM):
     def init_kv_caches(self, batch_size: int, max_length: int, dtype=None) -> list:
         config = self.config
         dtype = dtype or self.dtype
-        head_dim = config.n_embd // config.n_head
+        head_dim = config.head_dim
         conv_size = DeltaNet.conv_size  # dataclass default, the single source of truth
         caches = []
         for mixer in config.attention_pattern:

@@ -109,7 +109,7 @@ def fused_linear_cross_entropy(
     hidden: [B, S, H]; embedding: [V, H] (tied-embedding layout); labels: [B, S] with
     IGNORE_INDEX. Chunking is along sequence, so dp/fsdp/ep batch sharding is untouched.
     """
-    from flax import linen as nn
+    from ..parallel.sharding import logical_constraint
 
     B, S, H = hidden.shape
     chunk_size = min(chunk_size, S)
@@ -130,12 +130,21 @@ def fused_linear_cross_entropy(
     @jax.checkpoint
     def body(carry, xs):
         h, y = xs
-        logits = jnp.dot(h.astype(compute_dtype), emb.T)
+        # Pin the table to its ACTIVATION layout (vocab over tp only; replicated otherwise)
+        # INSIDE the rematerialized body so the replay sees it too. Under ZeRO-3 the tied
+        # table arrives fsdp-sharded along vocab; without this boundary the partitioner
+        # propagates that layout into the chunk's log_softmax backward where it collides
+        # with the batch-sharded logits constraint below — XLA then falls back to
+        # "involuntary full rematerialization" (full replication) of the logits-sized
+        # gradient. With it, the table is gathered at a clean boundary and grad_emb leaves
+        # as a reduce-scatter — exactly ZeRO-3's gather/compute/scatter contract.
+        table = logical_constraint(emb, ("act_vocab", None))
+        logits = jnp.dot(h.astype(compute_dtype), table.T)
         # keep the CE vocab-parallel ("act_vocab" -> tp) instead of all-gathering the table
         # per chunk. The chunk-local seq axis stays UNSHARDED (None, not "act_seq"): the
         # S -> (n_chunks, chunk) reshape already broke any sp sharding, and re-claiming
         # "act_seq" here forces an SPMD reshard of every chunk on sp>1 meshes.
-        logits = nn.with_logical_constraint(logits, ("act_batch", None, "act_vocab"))
+        logits = logical_constraint(logits, ("act_batch", None, "act_vocab"))
         if logit_scale is not None:
             logits = logits * logit_scale
         loss_sum, num = cross_entropy_loss(logits, y, upcast=upcast)

@@ -63,6 +63,10 @@ def get_logical_axis_rules(
         # activation axes
         ("act_batch", ("dp", "fsdp", "ep")),
         ("act_seq", act_seq),
+        # sequence axis INSIDE a tp region (qkv/mlp/logits tensors whose feature dim is
+        # already tp-sharded): Megatron-SP's extra tp on the sequence axis only applies
+        # BETWEEN tp regions — one mesh axis cannot shard two dims of the same tensor
+        ("act_seq_inner", ("sp",)),
         ("act_embed", None),
         ("act_heads", "tp"),
         ("act_kv_heads", "tp"),
@@ -71,6 +75,61 @@ def get_logical_axis_rules(
         ("act_experts", "ep"),
     ]
     return rules
+
+
+def _ambient_mesh():
+    """The mesh the surrounding program activated, under either JAX API: the new
+    `jax.sharding.set_mesh` (abstract mesh) or the classic `with mesh:` resource env."""
+    m = jax.sharding.get_abstract_mesh()
+    if m is not None and not m.empty:
+        return m
+    try:  # classic context; private import keeps the deprecated public shim quiet
+        from jax._src import mesh as _mesh_lib
+
+        pm = _mesh_lib.thread_resources.env.physical_mesh
+        return None if pm.empty else pm
+    except Exception:
+        return None
+
+
+def logical_constraint(x, axes):
+    """`nn.with_logical_constraint` that binds under the classic ``with mesh:`` context.
+
+    flax's version only engages when `jax.sharding.set_mesh` is active (its
+    `global_mesh_defined` check ignores the resource-env mesh) — and `set_mesh` cannot be
+    entered inside `jit`, where our model code runs. So resolve the ambient logical-axis
+    rules (set by `ModelWrapper.apply_scope`) here and emit a bare-PartitionSpec
+    `with_sharding_constraint`, which jit resolves against whichever mesh context is live.
+    No rules or no mesh -> no-op, so meshless single-chip programs are untouched.
+
+    Resolution follows flax: first matching rule wins; names without a rule (or mapping to
+    None) leave the dimension unconstrained-as-replicated; axes absent from the mesh are
+    dropped (size-1 axes are always present on MeshManager's 5-axis mesh, so this only
+    triggers on hand-built test meshes).
+    """
+    rules = nn.get_logical_axis_rules()
+    mesh = _ambient_mesh() if rules else None
+    if not rules or mesh is None:
+        return x
+    table: dict[str, tuple[str, ...] | str | None] = {}
+    for name, target in rules:
+        table.setdefault(name, target)
+    axis_names = set(mesh.axis_names)
+    entries = []
+    used: set[str] = set()  # a mesh axis may shard at most one dim; first dim wins
+    for a in axes:
+        target = table.get(a) if a is not None else None
+        if target is None:
+            entries.append(None)
+            continue
+        kept = tuple(
+            t
+            for t in (target if isinstance(target, tuple) else (target,))
+            if t in axis_names and t not in used
+        )
+        used.update(kept)
+        entries.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return jax.lax.with_sharding_constraint(x, PartitionSpec(*entries))
 
 
 def logical_to_mesh_sharding(logical_spec_tree, mesh: Mesh, rules: LogicalRules):
