@@ -22,6 +22,7 @@ depend on the installed transformers version knowing the architecture.
 from __future__ import annotations
 
 import json
+import math
 import os
 import shutil
 
@@ -29,6 +30,7 @@ import numpy as np
 
 from ..models.config import CommonConfig, MoEConfig
 from ..models.enums import AttentionHeadType
+from ..ops.activations import is_glu
 from ..utils.safetensors import SafeTensorsWeightsManager
 from .weights import interleave_qkv, split_qkv
 
@@ -534,6 +536,241 @@ def export_to_huggingface_bigcode(path: str, save_path: str) -> None:
     _copy_tokenizer_files(path, save_path)
 
 
+# ---------------------------------------------------------------------------- t5 / flan-t5
+
+
+def _t5_act_name(hf: dict) -> str:
+    """HF feed_forward_proj/dense_act_fn -> this framework's activation registry name."""
+    proj = hf.get("feed_forward_proj", "relu")
+    gated = proj.startswith("gated-") or hf.get("is_gated_act", False)
+    base = hf.get("dense_act_fn") or (proj[len("gated-"):] if gated else proj)
+    if base == "gelu" and proj == "gated-gelu":
+        # T5Config backward-compat special case: old v1.1 configs say "gated-gelu" with no
+        # dense_act_fn key, and HF resolves that to gelu_new (tanh), NOT exact gelu
+        base = "gelu_new"
+    base = {"gelu_new": "gelu_pytorch_tanh", "silu": "swish"}.get(base, base)
+    if not gated:
+        return base
+    return {"gelu": "geglu", "relu": "reglu", "swish": "swiglu"}.get(base, base + "_glu")
+
+
+def _t5_config_to_dolomite(hf: dict) -> dict:
+    """HF T5Config -> EncDecDolomiteConfig dict. Weight-exact architecture map: bucketed
+    relative bias (shared per stack), rmsnorm, no biases, unscaled attention (T5 folds the
+    1/sqrt(d) into its init), and — tied only (t5 v1.0) — the d_model**-0.5 logit scale
+    expressed as m_width = sqrt(d_model). v1.1/flan checkpoints untie the head and drop the
+    scale, which maps to tie_word_embeddings=False / m_width=None."""
+    num_heads = hf["num_heads"]
+    d_kv = hf.get("d_kv", hf["d_model"] // num_heads)
+    tied = hf.get("tie_word_embeddings", True)
+    return dict(
+        model_type="enc_dec_dolomite",
+        vocab_size=hf["vocab_size"],
+        # T5 has no absolute position table; n_positions only sizes caches/buffers here.
+        # 512 is the family's training length (HF tokenizer model_max_length)
+        n_positions=hf.get("n_positions", 512),
+        n_embd=hf["d_model"],
+        n_layer=hf.get("num_decoder_layers") or hf["num_layers"],
+        n_encoder_layer=hf["num_layers"],
+        n_head=num_heads,
+        num_key_value_heads=num_heads,
+        attention_head_type="mha",
+        attention_head_dim=_none_if(d_kv, hf["d_model"] // num_heads),
+        position_embedding_type="relative_bucketed",
+        relative_attention_num_buckets=hf.get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=hf.get("relative_attention_max_distance", 128),
+        n_inner=hf["d_ff"],
+        activation_function=_t5_act_name(hf),
+        normalization_function="rmsnorm",
+        layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-6),
+        use_cache=hf.get("use_cache", True),
+        add_bias=False,
+        tie_word_embeddings=tied,
+        attention_multiplier=1.0,
+        m_width=math.sqrt(hf["d_model"]) if tied else None,
+        initializer_range=hf.get("initializer_factor", 1.0) * 0.02,
+        attn_pdrop=hf.get("dropout_rate", 0.1),
+        resid_pdrop=hf.get("dropout_rate", 0.1),
+        embd_pdrop=hf.get("dropout_rate", 0.1),
+        bos_token_id=hf.get("bos_token_id"),
+        eos_token_id=hf.get("eos_token_id", 1),
+        pad_token_id=hf.get("pad_token_id", 0),
+        decoder_start_token_id=hf.get("decoder_start_token_id", hf.get("pad_token_id", 0)),
+    )
+
+
+def import_from_huggingface_t5(path: str, save_path: str) -> None:
+    """Import HF t5 / flan-t5 (`T5ForConditionalGeneration`) into enc_dec_dolomite.
+
+    Closes the reference's last seq2seq user journey (`arguments.py:72-76` loads any
+    `AutoModelForSeq2SeqLM`): `model_name: google/flan-t5-small` now finetunes natively.
+    Per-stack mapping (all weight-exact; torch [out, in] layout kept by weights.py):
+      layer.0.SelfAttention q|k|v -> flat-fused attn.c_attn, o -> attn.c_proj
+      layer.1.EncDecAttention q -> cross_attn.c_q, k|v -> fused cross_attn.c_kv, o -> c_proj
+      DenseReluDense wi -> mlp.c_fc (gated: [wi_1 (up) | wi_0 (gate)] matching the GLU
+      up-first fused layout, ops/activations.py), wo -> mlp.c_proj
+      block.0's relative_attention_bias -> the stack-level relative_bias table
+      final_layer_norm -> final_layernorm; shared.weight / lm_head.weight as configured.
+    """
+    hf = _read_config(path)
+    config_dict = _t5_config_to_dolomite(hf)
+    from ..models import config_from_dict
+
+    config = config_from_dict(config_dict)  # validate
+    gated = is_glu(config.activation_function)
+
+    manager = SafeTensorsWeightsManager(path)
+    get = manager.get_tensor
+    sd: dict[str, np.ndarray] = {"shared.weight": get("shared.weight")}
+    if not config.tie_word_embeddings:
+        sd["lm_head.weight"] = get("lm_head.weight")
+
+    for stack, n in (("encoder", config.n_encoder_layer), ("decoder", config.n_layer)):
+        sd[f"{stack}.relative_bias.weight"] = get(
+            f"{stack}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        )
+        sd[f"{stack}.final_layernorm.weight"] = get(f"{stack}.final_layer_norm.weight")
+        for i in range(n):
+            src = f"{stack}.block.{i}.layer."
+            dst = f"{stack}.block.{i}."
+            attn = src + "0.SelfAttention."
+            sd[dst + "attn.c_attn.weight"] = np.concatenate(
+                [get(attn + "q.weight"), get(attn + "k.weight"), get(attn + "v.weight")], axis=0
+            )
+            sd[dst + "attn.c_proj.weight"] = get(attn + "o.weight")
+            sd[dst + "ln_1.weight"] = get(src + "0.layer_norm.weight")
+
+            mlp_idx = 1
+            if stack == "decoder":
+                cross = src + "1.EncDecAttention."
+                sd[dst + "cross_attn.c_q.weight"] = get(cross + "q.weight")
+                sd[dst + "cross_attn.c_kv.weight"] = np.concatenate(
+                    [get(cross + "k.weight"), get(cross + "v.weight")], axis=0
+                )
+                sd[dst + "cross_attn.c_proj.weight"] = get(cross + "o.weight")
+                sd[dst + "ln_cross.weight"] = get(src + "1.layer_norm.weight")
+                mlp_idx = 2
+
+            mlp = src + f"{mlp_idx}.DenseReluDense."
+            if gated:
+                # T5 gated MLP: act(wi_0) * wi_1 -> fused [up | gate] = [wi_1 | wi_0]
+                sd[dst + "mlp.c_fc.weight"] = np.concatenate(
+                    [get(mlp + "wi_1.weight"), get(mlp + "wi_0.weight")], axis=0
+                )
+            else:
+                sd[dst + "mlp.c_fc.weight"] = get(mlp + "wi.weight")
+            sd[dst + "mlp.c_proj.weight"] = get(mlp + "wo.weight")
+            sd[dst + "ln_2.weight"] = get(src + f"{mlp_idx}.layer_norm.weight")
+
+    _finish_conversion(sd, config_dict, path, save_path)
+
+
+def export_to_huggingface_t5(path: str, save_path: str) -> None:
+    """enc_dec_dolomite -> HF t5 layout (inverse of `import_from_huggingface_t5`; only
+    configs the T5 architecture can express)."""
+    from ..models import config_from_dict
+
+    config = config_from_dict(_read_config(path))
+    assert config.position_embedding_type == "relative_bucketed"
+    assert config.normalization_function == "rmsnorm" and not config.add_bias
+    assert AttentionHeadType(config.attention_head_type) == AttentionHeadType.mha
+    # T5 math the HF class applies UNCONDITIONALLY: no softmax scale (folded into init),
+    # and — tied only — the d_model**-0.5 logit rescale. A config with different values
+    # would export bit-exact weights that compute different outputs.
+    assert config.attention_multiplier == 1.0, (
+        "T5 attention is unscaled; export requires attention_multiplier=1.0 "
+        f"(got {config.attention_multiplier})"
+    )
+    if config.tie_word_embeddings:
+        assert config.m_width is not None and abs(config.m_width - math.sqrt(config.n_embd)) < 1e-6, (
+            "tied-head T5 rescales logits by d_model**-0.5; export requires "
+            f"m_width=sqrt(n_embd) (got {config.m_width})"
+        )
+    else:
+        assert config.m_width is None, (
+            f"untied T5 applies no logit scale; export requires m_width=None (got {config.m_width})"
+        )
+    gated = is_glu(config.activation_function)
+    base = {
+        "geglu": "gelu",
+        "reglu": "relu",
+        "swiglu": "silu",
+        "gelu_pytorch_tanh_glu": "gelu_new",
+        "gelu_pytorch_tanh": "gelu_new",
+    }.get(config.activation_function, config.activation_function)
+
+    hf_config = dict(
+        model_type="t5",
+        architectures=["T5ForConditionalGeneration"],
+        vocab_size=config.vocab_size,
+        d_model=config.n_embd,
+        d_kv=config.head_dim,
+        d_ff=config.n_inner,
+        num_layers=config.n_encoder_layer,
+        num_decoder_layers=config.n_layer,
+        num_heads=config.n_head,
+        relative_attention_num_buckets=config.relative_attention_num_buckets,
+        relative_attention_max_distance=config.relative_attention_max_distance,
+        dropout_rate=config.resid_pdrop,
+        layer_norm_epsilon=config.layer_norm_epsilon,
+        feed_forward_proj=("gated-" + base) if gated else base,
+        dense_act_fn=base,
+        is_gated_act=gated,
+        use_cache=config.use_cache,
+        tie_word_embeddings=config.tie_word_embeddings,
+        eos_token_id=config.eos_token_id,
+        pad_token_id=config.pad_token_id,
+        decoder_start_token_id=config.decoder_start_token_id,
+    )
+
+    manager = SafeTensorsWeightsManager(path)
+    get = manager.get_tensor
+    sd: dict[str, np.ndarray] = {"shared.weight": get("shared.weight")}
+    if not config.tie_word_embeddings:
+        sd["lm_head.weight"] = get("lm_head.weight")
+
+    q_dim = config.n_head * config.head_dim
+    for stack, n in (("encoder", config.n_encoder_layer), ("decoder", config.n_layer)):
+        sd[f"{stack}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"] = get(
+            f"{stack}.relative_bias.weight"
+        )
+        sd[f"{stack}.final_layer_norm.weight"] = get(f"{stack}.final_layernorm.weight")
+        for i in range(n):
+            src = f"{stack}.block.{i}."
+            dst = f"{stack}.block.{i}.layer."
+            attn = dst + "0.SelfAttention."
+            qkv = get(src + "attn.c_attn.weight")
+            sd[attn + "q.weight"] = qkv[:q_dim]
+            sd[attn + "k.weight"] = qkv[q_dim : 2 * q_dim]
+            sd[attn + "v.weight"] = qkv[2 * q_dim :]
+            sd[attn + "o.weight"] = get(src + "attn.c_proj.weight")
+            sd[dst + "0.layer_norm.weight"] = get(src + "ln_1.weight")
+
+            mlp_idx = 1
+            if stack == "decoder":
+                cross = dst + "1.EncDecAttention."
+                sd[cross + "q.weight"] = get(src + "cross_attn.c_q.weight")
+                kv = get(src + "cross_attn.c_kv.weight")
+                sd[cross + "k.weight"] = kv[:q_dim]
+                sd[cross + "v.weight"] = kv[q_dim:]
+                sd[cross + "o.weight"] = get(src + "cross_attn.c_proj.weight")
+                sd[dst + "1.layer_norm.weight"] = get(src + "ln_cross.weight")
+                mlp_idx = 2
+
+            mlp = dst + f"{mlp_idx}.DenseReluDense."
+            fc = get(src + "mlp.c_fc.weight")
+            if gated:
+                up, gate = np.split(fc, 2, axis=0)
+                sd[mlp + "wi_1.weight"] = up
+                sd[mlp + "wi_0.weight"] = gate
+            else:
+                sd[mlp + "wi.weight"] = fc
+            sd[mlp + "wo.weight"] = get(src + "mlp.c_proj.weight")
+            sd[dst + f"{mlp_idx}.layer_norm.weight"] = get(src + "ln_2.weight")
+
+    _finish_conversion(sd, hf_config, path, save_path)
+
+
 # ---------------------------------------------------------------------------- dispatch
 
 _MODEL_IMPORT_FUNCTIONS = {
@@ -542,6 +779,7 @@ _MODEL_IMPORT_FUNCTIONS = {
     "granitemoe": import_from_huggingface_granitemoe,
     "llama": import_from_huggingface_llama,
     "mixtral": import_from_huggingface_mixtral,
+    "t5": import_from_huggingface_t5,
 }
 
 _MODEL_EXPORT_FUNCTIONS = {
@@ -550,6 +788,7 @@ _MODEL_EXPORT_FUNCTIONS = {
     "granitemoe": export_to_huggingface_granitemoe,
     "llama": export_to_huggingface_llama,
     "mixtral": export_to_huggingface_mixtral,
+    "t5": export_to_huggingface_t5,
 }
 
 

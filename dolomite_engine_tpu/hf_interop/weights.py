@@ -179,6 +179,11 @@ def _enc_dec_params_to_state_dict(config: CommonConfig, params: Any) -> dict[str
     matrices and the framework's flat [Q|K|V] fused projection (no interleave — there is no
     foreign checkpoint to match)."""
     sd: dict[str, np.ndarray] = {"shared.weight": params["wte"]["embedding"]}
+    if "lm_head" in params:  # untied head (tie_word_embeddings=False, e.g. imported flan-t5)
+        sd["lm_head.weight"] = np.ascontiguousarray(params["lm_head"]["kernel"].T)
+    if "rel_bias_enc" in params:  # position_embedding_type="relative_bucketed"
+        sd["encoder.relative_bias.weight"] = params["rel_bias_enc"]["embedding"]
+        sd["decoder.relative_bias.weight"] = params["rel_bias_dec"]["embedding"]
 
     for i in range(config.n_encoder_layer):
         b = params[f"encoder_{i}"]
@@ -212,6 +217,11 @@ def _enc_dec_params_to_state_dict(config: CommonConfig, params: Any) -> dict[str
 def _enc_dec_state_dict_to_params(config: CommonConfig, get_tensor) -> dict:
     bias = config.add_bias
     params: dict = {"wte": {"embedding": get_tensor("shared.weight")}}
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": np.ascontiguousarray(get_tensor("lm_head.weight").T)}
+    if config.position_embedding_type == "relative_bucketed":
+        params["rel_bias_enc"] = {"embedding": get_tensor("encoder.relative_bias.weight")}
+        params["rel_bias_dec"] = {"embedding": get_tensor("decoder.relative_bias.weight")}
 
     for i in range(config.n_encoder_layer):
         p = f"encoder.block.{i}."

@@ -58,6 +58,9 @@ class CommonConfig:
     # tensor is the largest single allocation in a train step at 50k vocab.
     fused_lm_head_loss: bool = False
     loss_chunk_size: int = 256
+    # per-head width when it differs from n_embd // n_head (HF T5's d_kv: flan-t5-small is
+    # 512 wide with 6 heads of 64); None derives it from n_embd
+    attention_head_dim: int | None = None
 
     def __post_init__(self) -> None:
         if self.n_inner is None:
@@ -75,7 +78,15 @@ class CommonConfig:
 
         # validate enums
         InitMethod(self.init_method)
-        PositionEmbeddingType(self.position_embedding_type)
+        pe_type = PositionEmbeddingType(self.position_embedding_type)
+        if pe_type not in self.supported_position_embeddings():
+            # an unsupported type would build a model with NO position information and
+            # train silently position-blind — fail at config time instead
+            raise ValueError(
+                f"{type(self).__name__} does not support position_embedding_type="
+                f"'{self.position_embedding_type}' (supported: "
+                f"{sorted(t.value for t in self.supported_position_embeddings())})"
+            )
         head_type = AttentionHeadType(self.attention_head_type)
 
         if head_type == AttentionHeadType.mha:
@@ -98,6 +109,18 @@ class CommonConfig:
                 "GroupedQueryAttention needs n_head divisible by num_key_value_heads"
             )
 
+    @classmethod
+    def supported_position_embeddings(cls) -> frozenset[PositionEmbeddingType]:
+        """Types this family actually builds; relative_bucketed is enc_dec-only."""
+        return frozenset(
+            {
+                PositionEmbeddingType.learned_absolute,
+                PositionEmbeddingType.alibi,
+                PositionEmbeddingType.rope,
+                PositionEmbeddingType.nope,
+            }
+        )
+
     # HF attribute_map aliases
     @property
     def hidden_size(self) -> int:
@@ -117,6 +140,8 @@ class CommonConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.attention_head_dim is not None:
+            return self.attention_head_dim
         assert self.n_embd % self.n_head == 0
         return self.n_embd // self.n_head
 
@@ -210,29 +235,32 @@ class EncDecDolomiteConfig(CommonConfig):
     shifted-right decoder input (HF seq2seq convention)."""
 
     model_type: str = "enc_dec_dolomite"
-    position_embedding_type: str = "rope"  # the only type the enc-dec stacks implement
+    position_embedding_type: str = "rope"
     n_encoder_layer: int | None = None
     decoder_start_token_id: int | None = None
     # residual-branch count for depth-scaled init (modeling_utils.depth_scaled_init_std);
     # set internally per stack — encoder blocks have 2 branches, decoder blocks 3
     init_residual_branches: int | None = None
+    # T5-style bucketed relative bias (position_embedding_type="relative_bucketed"):
+    # bucket count and the distance beyond which buckets saturate (HF T5 config names)
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+
+    @classmethod
+    def supported_position_embeddings(cls) -> frozenset[PositionEmbeddingType]:
+        # the stacks build neither wpe nor alibi slopes; relative_bucketed exists for
+        # weight-exact T5/flan-t5 import (hf_interop/conversion.py)
+        return frozenset(
+            {PositionEmbeddingType.rope, PositionEmbeddingType.relative_bucketed}
+        )
 
     def __post_init__(self) -> None:
         super().__post_init__()
-        # the model builds neither wpe nor alibi bias — accepting those configs would train
-        # a silently position-blind model
-        assert self.position_embedding_type == "rope", (
-            "enc_dec_dolomite supports position_embedding_type='rope' only "
-            f"(got '{self.position_embedding_type}')"
-        )
-        # the LM head is always the shared wte table; accepting untied would silently train
-        # a tied model under an untied config
-        assert self.tie_word_embeddings, "enc_dec_dolomite requires tie_word_embeddings"
         if self.n_encoder_layer is None:
             self.n_encoder_layer = self.n_layer
         if self.decoder_start_token_id is None:
-            self.decoder_start_token_id = (
-                self.bos_token_id if self.bos_token_id is not None else (self.pad_token_id or 0)
+            self.decoder_start_token_id = next(
+                (t for t in (self.bos_token_id, self.pad_token_id) if t is not None), 0
             )
 
 

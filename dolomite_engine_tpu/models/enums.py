@@ -13,6 +13,9 @@ class PositionEmbeddingType(Enum):
     alibi = "alibi"
     rope = "rope"
     nope = "nope"
+    # T5-style learned bucketed relative attention bias (enc_dec_dolomite only; enables
+    # weight-exact import of HF t5/flan-t5 checkpoints, hf_interop/conversion.py)
+    relative_bucketed = "relative_bucketed"
 
 
 class AttentionHeadType(Enum):

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.sharding import logical_constraint
+
 from ..enums import AttentionImplementation
 from ..ops.activations import get_activation_function, is_glu
 from ..ops.attention import attention as attention_op
@@ -131,7 +133,16 @@ class ParameterizedEmbedding(nn.Module):
             (self.num_embeddings, self.features),
             jnp.float32,
         )
-        return jnp.take(embedding.astype(self.dtype), ids, axis=0)
+        # Lookup against the table's ACTIVATION layout: keep a vocab axis tp-sharded
+        # (masked gather + psum, Megatron-style) but release the param-only shardings
+        # (ZeRO-3 fsdp on the feature dim). Without this boundary the gather's backward
+        # scatter-add pulls the output cotangent toward the (tp, fsdp) param layout while
+        # the downstream batch-sharded activation constraint pulls it the other way, and
+        # the partitioner falls back to full rematerialization. With it, grad flows to the
+        # param through one clean reduce-scatter — ZeRO-3's gather/compute/scatter contract.
+        act_axes = tuple("act_vocab" if a == "vocab" else None for a in self.embedding_axes)
+        table = logical_constraint(embedding.astype(self.dtype), act_axes)
+        return jnp.take(table, ids, axis=0)
 
     def attend(self, x: jax.Array) -> jax.Array:
         """Tied LM head: x @ embedding.T (vocab-parallel when "vocab" -> tp)."""
@@ -282,7 +293,7 @@ class Attention(nn.Module):
 
         batch, seq = hidden_states.shape[:2]
         qkv = c_attn(hidden_states)
-        qkv = nn.with_logical_constraint(qkv, ("act_batch", "act_seq", "act_heads"))
+        qkv = logical_constraint(qkv, ("act_batch", "act_seq_inner", "act_heads"))
 
         query, key, value = jnp.split(
             qkv, [num_heads * head_dim, (num_heads + num_kv_heads) * head_dim], axis=-1
@@ -390,7 +401,7 @@ class MLP(nn.Module):
 
         act = get_activation_function(config.activation_function)
         h = c_fc(hidden_states)
-        h = nn.with_logical_constraint(h, ("act_batch", "act_seq", "act_mlp"))
+        h = logical_constraint(h, ("act_batch", "act_seq_inner", "act_mlp"))
         h = act(h)
         h = c_proj(h)
         h = nn.Dropout(rate=config.resid_pdrop)(h, deterministic=deterministic)
@@ -399,9 +410,14 @@ class MLP(nn.Module):
 
 class CrossAttention(nn.Module):
     """Encoder-decoder cross-attention: queries from the decoder stream, fused K/V from the
-    encoder output. No KV cache / RoPE — encoder K/V are static per sequence and positions
-    live in the self-attention sublayers. Runs sdpa: q_len != kv_len in general, so the
-    causal Pallas kernels don't apply, and cross shapes in finetuning are modest."""
+    encoder output. No RoPE — encoder K/V are static per sequence and positions live in the
+    self-attention sublayers. Runs sdpa: q_len != kv_len in general, so the causal Pallas
+    kernels don't apply, and cross shapes in finetuning are modest.
+
+    Decode-time caching: the K/V projection depends only on the (static) encoder output, so
+    generation projects it ONCE (`precompute_only=True` -> (k, v)) and feeds it back via
+    `cross_kv` every step — without this each decode step re-pays the O(S_enc * D * 2D_kv)
+    c_kv matmul per layer."""
 
     config: CommonConfig
     dtype: Dtype = jnp.float32
@@ -409,11 +425,13 @@ class CrossAttention(nn.Module):
     @nn.compact
     def __call__(
         self,
-        hidden_states: jax.Array,
-        encoder_hidden_states: jax.Array,
+        hidden_states: jax.Array | None,
+        encoder_hidden_states: jax.Array | None,
         encoder_attention_mask: jax.Array | None = None,
         deterministic: bool = True,
-    ) -> jax.Array:
+        cross_kv: tuple[jax.Array, jax.Array] | None = None,
+        precompute_only: bool = False,
+    ) -> jax.Array | tuple[jax.Array, jax.Array]:
         config = self.config
         num_heads = config.n_head
         num_kv_heads = config.num_key_value_heads
@@ -452,14 +470,19 @@ class CrossAttention(nn.Module):
             name="c_proj",
         )
 
-        batch, q_seq = hidden_states.shape[:2]
-        kv_seq = encoder_hidden_states.shape[1]
+        if precompute_only or cross_kv is None:
+            batch, kv_seq = encoder_hidden_states.shape[:2]
+            kv = c_kv(encoder_hidden_states)
+            key, value = jnp.split(kv, 2, axis=-1)
+            key = key.reshape(batch, kv_seq, num_kv_heads, head_dim)
+            value = value.reshape(batch, kv_seq, num_kv_heads, head_dim)
+            if precompute_only:
+                return key, value
+        else:
+            key, value = cross_kv
 
+        batch, q_seq = hidden_states.shape[:2]
         query = c_q(hidden_states).reshape(batch, q_seq, num_heads, head_dim)
-        kv = c_kv(encoder_hidden_states)
-        key, value = jnp.split(kv, 2, axis=-1)
-        key = key.reshape(batch, kv_seq, num_kv_heads, head_dim)
-        value = value.reshape(batch, kv_seq, num_kv_heads, head_dim)
 
         dropout_rng = None
         attn_pdrop = 0.0 if deterministic else config.attn_pdrop
@@ -539,7 +562,7 @@ class Block(nn.Module):
             mlp_out = mlp_out * m_residual
         hidden_states = residual + mlp_out
 
-        hidden_states = nn.with_logical_constraint(
+        hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
         return hidden_states, kv_cache

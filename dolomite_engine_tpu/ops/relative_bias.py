@@ -1,0 +1,95 @@
+"""T5-style bucketed relative attention bias.
+
+Parity: the reference finetunes HF T5 via `AutoModelForSeq2SeqLM`
+(`/root/reference/dolomite_engine/arguments.py:72-76`); this op gives `enc_dec_dolomite`
+the same position encoding so hf_interop can import those checkpoints weight-exactly.
+
+Semantics (T5 paper §2.13 / HF `T5Attention._relative_position_bucket` behavior,
+re-derived here): the signed key-minus-query distance is mapped to one of
+``num_buckets`` learned per-head scalars. Near distances get one bucket each; far
+distances share logarithmically-spaced buckets up to ``max_distance``, beyond which the
+last bucket saturates. Bidirectional stacks (encoder) split the buckets between past and
+future; causal stacks (decoder) bucket only the past and collapse any future distance to
+bucket 0. The learned [num_buckets, num_heads] table is shared by every layer of a stack
+and rides the standard additive-bias slot of `ops/attention.py` (same path as alibi).
+
+Everything is static-shape jnp on [q_len, k_len] index grids — one tiny gather per
+forward, fused by XLA; `query_offset` may be a traced scalar (scan decode).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def relative_position_bucket(
+    relative_position: jax.Array,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """Map signed (key - query) distances to bucket ids in [0, num_buckets)."""
+    rp = relative_position
+    if bidirectional:
+        directional_buckets = num_buckets // 2
+        offset = jnp.where(rp > 0, directional_buckets, 0).astype(jnp.int32)
+        distance = jnp.abs(rp)
+    else:
+        directional_buckets = num_buckets
+        offset = jnp.zeros_like(rp, dtype=jnp.int32)
+        # causal: only keys at or before the query are meaningful; future distances
+        # (which the causal mask removes anyway) collapse to distance 0
+        distance = jnp.maximum(-rp, 0)
+
+    exact = directional_buckets // 2
+    # distances past `exact` share log-spaced buckets that saturate at max_distance
+    log_scale = (directional_buckets - exact) / math.log(max_distance / exact)
+    scaled = jnp.log(jnp.maximum(distance, 1).astype(jnp.float32) / exact) * log_scale
+    log_bucket = exact + scaled.astype(jnp.int32)
+    log_bucket = jnp.minimum(log_bucket, directional_buckets - 1)
+
+    return offset + jnp.where(distance < exact, distance, log_bucket)
+
+
+class RelativePositionBias(nn.Module):
+    """Learned per-head bias table -> additive attention bias [1, heads, q_len, k_len].
+
+    One instance per stack (T5 stores the table on each stack's first block and shares it
+    across that stack's layers; here it lives at the model level, one gather per forward).
+    """
+
+    num_heads: int
+    num_buckets: int = 32
+    max_distance: int = 128
+    bidirectional: bool = True
+    std: float = 0.02
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, q_len: int, k_len: int, query_offset: jax.Array | int = 0) -> jax.Array:
+        table = self.param(
+            "embedding",
+            nn.with_partitioning(
+                nn.initializers.normal(stddev=self.std), (None, "heads")
+            ),
+            (self.num_buckets, self.num_heads),
+            jnp.float32,
+        )
+        if hasattr(table, "unbox"):
+            table = table.unbox()
+        q_pos = query_offset + jnp.arange(q_len)[:, None]
+        k_pos = jnp.arange(k_len)[None, :]
+        buckets = relative_position_bucket(
+            k_pos - q_pos,
+            bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets,
+            max_distance=self.max_distance,
+        )
+        bias = jnp.take(table.astype(self.dtype), buckets, axis=0)  # [q, k, heads]
+        return jnp.transpose(bias, (2, 0, 1))[None]

@@ -1,0 +1,296 @@
+"""HF t5/flan-t5 -> enc_dec_dolomite conversion tests.
+
+Parity target: the reference finetunes any HF `AutoModelForSeq2SeqLM`
+(`/root/reference/dolomite_engine/arguments.py:72-76`); these tests prove the import is
+WEIGHT-EXACT by checking teacher-forced logits against `T5ForConditionalGeneration` on
+CPU torch, for both architecture generations:
+  - t5 v1.0 style: relu MLP, tied head + d_model**-0.5 logit scale
+  - t5 v1.1 / flan style: gated-gelu MLP, untied lm_head, d_kv != d_model / num_heads
+plus import->export round-trip bit-equality.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from dolomite_engine_tpu.hf_interop import (
+    export_to_huggingface,
+    import_from_huggingface,
+    state_dict_to_params,
+)
+from dolomite_engine_tpu.models import config_from_dict, get_model_class
+from dolomite_engine_tpu.utils.safetensors import SafeTensorsWeightsManager
+
+from ..test_commons import assert_allclose
+
+IGNORE_INDEX = -100
+
+
+def _tiny_t5(tmp_path, *, v1_1: bool):
+    from transformers import T5Config, T5ForConditionalGeneration
+
+    torch.manual_seed(0)
+    config = T5Config(
+        vocab_size=96,
+        d_model=48,
+        # v1.1/flan: per-head width independent of d_model (flan-t5-small is 512/6 heads)
+        d_kv=16 if v1_1 else 8,
+        d_ff=64,
+        num_layers=2,
+        num_decoder_layers=2,
+        num_heads=6,
+        relative_attention_num_buckets=8,
+        relative_attention_max_distance=20,
+        dropout_rate=0.0,
+        feed_forward_proj="gated-gelu" if v1_1 else "relu",
+        tie_word_embeddings=not v1_1,
+        pad_token_id=0,
+        eos_token_id=1,
+        decoder_start_token_id=0,
+    )
+    model = T5ForConditionalGeneration(config).eval()
+    path = str(tmp_path / "hf_t5")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def _batch(rs):
+    ids = rs.randint(2, 96, (2, 12))
+    mask = np.ones_like(ids)
+    mask[1, 9:] = 0
+    ids[1, 9:] = 0
+    labels = rs.randint(2, 96, (2, 7))
+    labels[0, 5:] = IGNORE_INDEX
+    return ids, mask, labels
+
+
+@pytest.mark.parametrize("v1_1", [False, True], ids=["t5_v1_0_tied_relu", "t5_v1_1_untied_geglu"])
+def test_t5_import_logits_parity(tmp_path, v1_1):
+    hf_model, hf_path = _tiny_t5(tmp_path, v1_1=v1_1)
+    dolomite_path = str(tmp_path / "dolomite")
+    import_from_huggingface(hf_path, dolomite_path)
+
+    ids, mask, labels = _batch(np.random.RandomState(0))
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            labels=torch.tensor(labels),
+        )
+
+    config = config_from_dict(json.load(open(os.path.join(dolomite_path, "config.json"))))
+    model = get_model_class(config.model_type)(config=config)
+    params = state_dict_to_params(config, SafeTensorsWeightsManager(dolomite_path))
+    out = model.apply(
+        {"params": params},
+        jnp.asarray(ids, jnp.int32),
+        attention_mask=jnp.asarray(mask, jnp.int32),
+        labels=jnp.asarray(labels, jnp.int32),
+    )
+
+    assert_allclose(
+        np.asarray(out.logits, np.float32), ref.logits.float().numpy(), atol=2e-4, rtol=2e-4
+    )
+    # same masked-mean CE (HF averages over non-ignored label tokens the same way)
+    assert abs(float(out.loss) - float(ref.loss)) < 2e-4
+
+
+@pytest.mark.parametrize("v1_1", [False, True], ids=["t5_v1_0", "t5_v1_1"])
+def test_t5_roundtrip_bit_equality(tmp_path, v1_1):
+    _, hf_path = _tiny_t5(tmp_path, v1_1=v1_1)
+    dolomite_path = str(tmp_path / "dolomite")
+    roundtrip_path = str(tmp_path / "hf_roundtrip")
+
+    import_from_huggingface(hf_path, dolomite_path)
+    export_to_huggingface(dolomite_path, roundtrip_path, model_type="t5")
+
+    original = SafeTensorsWeightsManager(hf_path)
+    roundtrip = SafeTensorsWeightsManager(roundtrip_path)
+    # HF duplicates `shared` into encoder/decoder embed_tokens in some save versions;
+    # compare the canonical tensor set the importer consumes
+    for name in roundtrip.state_dict():
+        assert np.array_equal(roundtrip.get_tensor(name), original.get_tensor(name)), name
+
+    original_config = json.load(open(os.path.join(hf_path, "config.json")))
+    roundtrip_config = json.load(open(os.path.join(roundtrip_path, "config.json")))
+    for key in ("vocab_size", "d_model", "d_kv", "d_ff", "num_heads"):
+        assert original_config[key] == roundtrip_config[key]
+    # HF omits default-valued keys (tie_word_embeddings=True) from saved configs
+    assert original_config.get("tie_word_embeddings", True) == roundtrip_config.get(
+        "tie_word_embeddings", True
+    )
+
+
+def test_t5_act_name_gated_gelu_backcompat():
+    """Old v1.1 configs say feed_forward_proj='gated-gelu' with NO dense_act_fn; HF resolves
+    that to gelu_new (tanh), not exact gelu — the import must match or every MLP diverges."""
+    from dolomite_engine_tpu.hf_interop.conversion import _t5_act_name
+
+    assert _t5_act_name({"feed_forward_proj": "gated-gelu"}) == "gelu_pytorch_tanh_glu"
+    assert _t5_act_name({"feed_forward_proj": "gated-gelu", "dense_act_fn": "gelu_new"}) == (
+        "gelu_pytorch_tanh_glu"
+    )
+    assert _t5_act_name({"feed_forward_proj": "relu"}) == "relu"
+    assert _t5_act_name({"feed_forward_proj": "gated-silu"}) == "swiglu"
+
+
+def test_relative_bucketed_rejected_outside_enc_dec():
+    """Decoder-only families build no relative-bias table; accepting the type would train a
+    silently position-blind model."""
+    from dolomite_engine_tpu.models import config_from_dict
+
+    with pytest.raises(ValueError, match="position_embedding_type"):
+        config_from_dict(
+            dict(model_type="gpt_dolomite", position_embedding_type="relative_bucketed")
+        )
+
+
+def test_lora_seq2seq_generation_paths(tmp_path):
+    """LoRA-wrapped seq2seq generation: encode / precompute_cross_kv must resolve through
+    the wrapper (inside the LoRA scope) — generation crashed otherwise."""
+    import jax
+
+    from dolomite_engine_tpu.generation_utils import generate_seq2seq_tokens
+    from dolomite_engine_tpu.models.config import EncDecDolomiteConfig
+    from dolomite_engine_tpu.models.enc_dec_dolomite import EncDecDolomiteForSeq2SeqLM
+    from dolomite_engine_tpu.peft.lora import LoRACausalLM
+
+    config = EncDecDolomiteConfig(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_encoder_layer=2,
+        n_head=4, attention_head_type="mha", position_embedding_type="rope",
+        activation_function="swiglu", normalization_function="rmsnorm", add_bias=False,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        bos_token_id=0, eos_token_id=1, pad_token_id=2,
+    )
+    model = LoRACausalLM(
+        base_model=EncDecDolomiteForSeq2SeqLM(config=config),
+        rank=2, alpha=4.0, dropout=0.0, targets=("c_attn", "c_q", "c_kv"),
+    )
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(3, 64, (2, 6)), jnp.int32)
+    labels = jnp.asarray(rs.randint(3, 64, (2, 4)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids, labels=labels)
+
+    generated, num_generated = generate_seq2seq_tokens(
+        model,
+        variables,
+        ids,
+        jnp.ones_like(ids),
+        jax.random.PRNGKey(1),
+        max_new_tokens=4,
+        decoder_start_token_id=0,
+        pad_token_id=2,
+        eos_token_id=1,
+    )
+    assert generated.shape == (2, 4)
+    assert all(0 < int(n) <= 4 for n in num_generated)
+
+
+def test_seq2seq_generation_with_checkpointed_model():
+    """Generation on an enc-dec model built WITH gradient checkpointing (a wrapper reloaded
+    from training args keeps checkpoint_every set): cross-KV precompute must route through
+    the remat-wrapped blocks — regression: it asserted 'inference path' and crashed."""
+    import jax
+
+    from dolomite_engine_tpu.generation_utils import generate_seq2seq_tokens
+    from dolomite_engine_tpu.models.config import EncDecDolomiteConfig
+    from dolomite_engine_tpu.models.enc_dec_dolomite import EncDecDolomiteForSeq2SeqLM
+
+    config = EncDecDolomiteConfig(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_encoder_layer=2,
+        n_head=4, attention_head_type="mha", position_embedding_type="rope",
+        activation_function="swiglu", normalization_function="rmsnorm", add_bias=False,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        bos_token_id=0, eos_token_id=1, pad_token_id=2,
+    )
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(3, 64, (2, 6)), jnp.int32)
+    labels = jnp.asarray(rs.randint(3, 64, (2, 4)), jnp.int32)
+
+    plain = EncDecDolomiteForSeq2SeqLM(config=config)
+    params = plain.init(jax.random.PRNGKey(0), ids, labels=labels)
+    ckpt = EncDecDolomiteForSeq2SeqLM(config=config, checkpoint_every=1)
+
+    out_plain = generate_seq2seq_tokens(
+        plain, params, ids, jnp.ones_like(ids), jax.random.PRNGKey(1),
+        max_new_tokens=4, decoder_start_token_id=0, pad_token_id=2, eos_token_id=1,
+    )
+    out_ckpt = generate_seq2seq_tokens(
+        ckpt, params, ids, jnp.ones_like(ids), jax.random.PRNGKey(1),
+        max_new_tokens=4, decoder_start_token_id=0, pad_token_id=2, eos_token_id=1,
+    )
+    np.testing.assert_array_equal(np.asarray(out_plain[0]), np.asarray(out_ckpt[0]))
+
+
+class _StubT5Tokenizer:
+    eos_token_id = 1
+    pad_token_id = 0
+    vocab_size = 96
+
+    def __call__(self, text, add_special_tokens=False):
+        return {"input_ids": [2 + ord(c) % 90 for c in str(text)]}
+
+    def __len__(self):
+        return self.vocab_size
+
+    def save_pretrained(self, path):
+        pass
+
+
+def test_finetune_from_imported_flan_t5(tmp_path, monkeypatch, eight_devices):
+    """The reference's last seq2seq journey (`arguments.py:72-76`): finetune a pretrained HF
+    encoder-decoder. Import a (random-init) flan-t5-style checkpoint, then drive the real
+    finetune CLI with `model_name:` pointing at the imported dir on the 8-device mesh."""
+    from dolomite_engine_tpu import finetune
+    from dolomite_engine_tpu.arguments import TrainingArgs
+    from dolomite_engine_tpu.model_wrapper import base as mw_base
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    _, hf_path = _tiny_t5(tmp_path, v1_1=True)
+    dolomite_path = str(tmp_path / "dolomite")
+    import_from_huggingface(hf_path, dolomite_path)
+
+    monkeypatch.setattr(
+        mw_base.ModelWrapper,
+        "_setup_tokenizer",
+        lambda self, tokenizer_name, additional_special_tokens: setattr(
+            self, "tokenizer", _StubT5Tokenizer()
+        ),
+    )
+
+    MeshManager.destroy()
+    args = TrainingArgs(
+        model_args=dict(
+            model_class="AutoModelForSeq2SeqLM",
+            model_name=dolomite_path,
+        ),
+        tuning_args=dict(tuning_method="full_finetuning"),
+        training_parameters=dict(
+            num_training_steps=3,
+            micro_batch_size=8,
+            gradient_accumulation_steps=2,
+            eval_during_training=False,
+        ),
+        datasets=[
+            dict(
+                class_name="DebugDataset",
+                data_name="debug",
+                class_args=dict(num_examples=64),
+                max_input_tokens=8,
+                max_output_tokens=8,
+            )
+        ],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=3),
+        logging_args=dict(log_interval=1),
+        random_args=dict(seed=7),
+    )
+    finetune.main(args=args)
+
+    latest = tmp_path / "ckpt" / "latest_checkpointed_iteration.json"
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 3
+    MeshManager.destroy()
