@@ -140,6 +140,10 @@ class LoRAArgs(BaseArgs):
     lora_alpha: float = 32.0
     # dropout applied to LoRA adapter inputs
     lora_dropout: float = 0.1
+    # linear-module name fragments that grow adapters; None selects per-architecture
+    # defaults (fused c_attn; encoder-decoder models additionally adapt the
+    # cross-attention c_q/c_kv projections, the most task-specific part of a seq2seq tune)
+    lora_target_modules: list[str] | None = None
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None([(self.lora_rank, "lora_rank")])

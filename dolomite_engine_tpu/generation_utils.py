@@ -147,17 +147,20 @@ def generate_seq2seq_tokens(
     decoder_start_token_id: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Encoder-decoder decode: one encoder pass, then `lax.scan` over decoder steps with the
-    standard self-attention KV cache; cross-attention K/V recompute from the static encoder
-    output each step (models/enc_dec_dolomite.py). Prompts are the ENCODER inputs
-    (left-padded, like the decoder-only path); the decoder starts from
-    `decoder_start_token_id`."""
+    standard self-attention KV cache. Cross-attention K/V are projected ONCE from the static
+    encoder output (models/enc_dec_dolomite.py precompute_cross_kv) and reused every step.
+    Prompts are the ENCODER inputs (left-padded, like the decoder-only path); the decoder
+    starts from `decoder_start_token_id`."""
     batch = input_ids.shape[0]
     variables = {"params": params} if "params" not in params else params
 
     encoder_hidden_states = model.apply(
         variables, input_ids, attention_mask, method="encode"
     )
-    caches = model.init_kv_caches(batch, max_new_tokens + 1)
+    cross_kv_caches = model.apply(
+        variables, encoder_hidden_states, method="precompute_cross_kv"
+    )
+    caches = model.init_kv_caches(batch, max_new_tokens)
     start = jnp.full((batch,), decoder_start_token_id, jnp.int32)
     finished0 = jnp.zeros((batch,), bool)
 
@@ -170,6 +173,7 @@ def generate_seq2seq_tokens(
             decoder_input_ids=token[:, None],
             encoder_hidden_states=encoder_hidden_states,
             kv_caches=caches,
+            cross_kv_caches=cross_kv_caches,
             cache_index=i,
         )
         rng, step_rng = jax.random.split(rng)

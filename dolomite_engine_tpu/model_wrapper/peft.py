@@ -24,11 +24,19 @@ class ModelWrapperForPEFT(ModelWrapperForFinetuning):
         if self.tuning_method == TuningMethod.lora:
             from ..peft.lora import LoRACausalLM
 
+            if self.lora_args.lora_target_modules is not None:
+                targets = tuple(self.lora_args.lora_target_modules)
+            elif self.is_encoder_decoder:
+                # cross-attention carries most of the task adaptation in a seq2seq tune
+                targets = ("c_attn", "c_q", "c_kv")
+            else:
+                targets = ("c_attn",)
             self.model = LoRACausalLM(
                 base_model=self.model,
                 rank=self.lora_args.lora_rank,
                 alpha=self.lora_args.lora_alpha,
                 dropout=self.lora_args.lora_dropout,
+                targets=targets,
             )
         elif self.tuning_method == TuningMethod.prompt_tuning:
             from ..peft.prompt_tuning import PromptTuningCausalLM

@@ -127,7 +127,11 @@ def test_lora_on_seq2seq_family():
         bos_token_id=0, eos_token_id=1, pad_token_id=2,
     )
     base = EncDecDolomiteForSeq2SeqLM(config=config)
-    lora = LoRACausalLM(base_model=base, rank=4, alpha=8.0, dropout=0.0)
+    # the seq2seq default target set (model_wrapper/peft.py): self-attention plus the
+    # cross-attention q/kv projections
+    lora = LoRACausalLM(
+        base_model=base, rank=4, alpha=8.0, dropout=0.0, targets=("c_attn", "c_q", "c_kv")
+    )
 
     rs = np.random.RandomState(0)
     ids = jnp.asarray(rs.randint(3, 128, size=(2, 16)), jnp.int32)
@@ -137,6 +141,8 @@ def test_lora_on_seq2seq_family():
     p = lora_vars["params"]["base_model"]
     assert "lora_a" in p["encoder_0"]["attn"]["c_attn"]
     assert "lora_a" in p["decoder_0"]["attn"]["c_attn"]
+    assert "lora_a" in p["decoder_0"]["cross_attn"]["c_q"]
+    assert "lora_a" in p["decoder_0"]["cross_attn"]["c_kv"]
 
     out = lora.apply(lora_vars, ids, labels=labels)
     assert np.isfinite(float(out.loss))
@@ -144,5 +150,5 @@ def test_lora_on_seq2seq_family():
     mask = peft_trainable_mask(lora_vars["params"])
     leaves = jax.tree_util.tree_leaves_with_path(mask)
     trainable = [jax.tree_util.keystr(pth) for pth, v in leaves if v]
-    # c_attn in 2 encoder + 2 decoder blocks, a+b each
-    assert len(trainable) == 8 and all("lora" in t for t in trainable)
+    # c_attn in 2 encoder + 2 decoder blocks, cross c_q + c_kv in 2 decoder blocks; a+b each
+    assert len(trainable) == 16 and all("lora" in t for t in trainable)
