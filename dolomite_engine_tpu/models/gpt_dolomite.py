@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..parallel.sharding import logical_constraint
+
 from ..enums import AttentionImplementation
 from ..ops.loss import causal_lm_loss, derive_causal_labels, fused_linear_cross_entropy
 from ..ops.rope import RoPEParams
@@ -55,6 +57,16 @@ _REMAT_POLICIES = (
     "everything_saveable",
     "nothing_saveable",
 )
+
+
+def scan_group_size(n_layer: int, checkpoint_every: int) -> int:
+    """Blocks per scan step under `scan_layers`: `checkpoint_every` when it enables the
+    grouped every-k remat (k > 1 dividing n_layer, `BlockGroup`), else 1. Single source of
+    truth for the model's param layout AND checkpoint load (model_wrapper/base.py) — the
+    two must agree or loading produces a tree that no longer matches the shardings."""
+    if checkpoint_every > 1 and n_layer % checkpoint_every == 0:
+        return checkpoint_every
+    return 1
 
 
 def resolve_remat_policy(name: str | None):
@@ -117,32 +129,43 @@ class GPTDolomiteModel(nn.Module):
                 "scan_layers with fp8 delayed-scaling state is not supported"
             )
             cls = self.block_cls
+            scan_length = self.num_blocks
+            inst_kwargs = dict(
+                config=config,
+                attention_implementation=self.attention_implementation,
+                dtype=self.dtype,
+            )
+            group_size = scan_group_size(self.num_blocks, self.checkpoint_every)
+            if group_size > 1:
+                # every-k remat under scan: scan over GROUPS of k blocks, remat each group
+                # once — the scan carry is then saved every k layers, exactly the unrolled
+                # every-k policy. Param layout: h_scan.b{j} stacked over groups
+                # (stack_block_params/unstack_block_params convert).
+                cls = BlockGroup
+                scan_length = self.num_blocks // group_size
+                inst_kwargs.update(block_cls=self.block_cls, group_size=group_size)
+            elif self.checkpoint_every > 1:
+                import logging
+
+                from ..utils import log_rank_0
+
+                log_rank_0(
+                    logging.WARNING,
+                    f"scan_layers remats EVERY block: checkpoint_every="
+                    f"{self.checkpoint_every} does not divide n_layer={self.num_blocks}, "
+                    "so the every-k grouping is unavailable — expect the full-remat "
+                    "memory/compute tradeoff",
+                )
             if self.checkpoint_every:
-                # scan granularity is per-layer: every block remats, not every k-th
-                if self.checkpoint_every > 1:
-                    import logging
-
-                    from ..utils import log_rank_0
-
-                    log_rank_0(
-                        logging.WARNING,
-                        f"scan_layers remats EVERY block; checkpoint_every="
-                        f"{self.checkpoint_every} (every-k-th) is not expressible under "
-                        "one scanned layer — expect the full-remat memory/compute tradeoff",
-                    )
                 cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy)
             self.h_scan = nn.scan(
                 cls,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,) * 7,
-                length=self.num_blocks,
+                length=scan_length,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(
-                config=config,
-                attention_implementation=self.attention_implementation,
-                dtype=self.dtype,
-            )
+            )(**inst_kwargs)
         else:
             blocks = []
             for i in range(self.num_blocks):
@@ -206,7 +229,7 @@ class GPTDolomiteModel(nn.Module):
             hidden_states = hidden_states * config.m_emb
 
         hidden_states = self.drop(hidden_states, deterministic=deterministic)
-        hidden_states = nn.with_logical_constraint(
+        hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
 
@@ -268,25 +291,91 @@ class GPTDolomiteModel(nn.Module):
         return hidden_states, new_caches, extras
 
 
-def stack_block_params(params: dict, n_layer: int) -> dict:
-    """Unrolled `transformer.h_0..h_{L-1}` -> scanned `transformer.h_scan` with a leading
-    [n_layer] axis (the layout `scan_layers=True` models expect). Operates on (and returns)
-    unboxed trees — runtime param trees are unboxed by design; boxed inputs are unboxed."""
+class BlockGroup(nn.Module):
+    """`group_size` consecutive blocks as ONE scan step (training path only).
+
+    Exists so every-k gradient checkpointing composes with `scan_layers`: the model remats
+    each GROUP, making the scan carry a checkpoint every k layers — the same memory/compute
+    point as the unrolled every-k policy, while XLA still compiles a single group body.
+    Signature mirrors `modeling_utils.Block` so the scan plumbing is identical.
+    """
+
+    config: CommonConfig
+    attention_implementation: AttentionImplementation = AttentionImplementation.sdpa
+    dtype: Any = jnp.float32
+    block_cls: type = Block
+    group_size: int = 1
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: jax.Array,
+        attention_mask=None,
+        segment_ids=None,
+        rope_cos_sin=None,
+        alibi_bias=None,
+        kv_cache=None,
+        cache_index=None,
+        deterministic: bool = True,
+    ):
+        assert kv_cache is None and cache_index is None  # training path (no caches)
+        for j in range(self.group_size):
+            hidden_states, _ = self.block_cls(
+                config=self.config,
+                attention_implementation=self.attention_implementation,
+                dtype=self.dtype,
+                name=f"b{j}",
+            )(
+                hidden_states,
+                attention_mask,
+                segment_ids,
+                rope_cos_sin,
+                alibi_bias,
+                None,
+                None,
+                deterministic,
+            )
+        return hidden_states, None
+
+
+def stack_block_params(params: dict, n_layer: int, group_size: int = 1) -> dict:
+    """Unrolled `transformer.h_0..h_{L-1}` -> scanned `transformer.h_scan` (the layout
+    `scan_layers=True` models expect). `group_size=1`: block trees stacked on a leading
+    [n_layer] axis. `group_size=k` (every-k remat under scan, `BlockGroup`): sub-trees
+    `b0..b{k-1}` each stacked over the n_layer/k groups, where `b{j}` of group g is layer
+    g*k+j. Operates on (and returns) unboxed trees — runtime param trees are unboxed by
+    design; boxed inputs are unboxed."""
     params = nn.unbox(params)
     t = dict(params["transformer"])
     blocks = [t.pop(f"h_{i}") for i in range(n_layer)]
-    t["h_scan"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    if group_size > 1:
+        assert n_layer % group_size == 0, (n_layer, group_size)
+        t["h_scan"] = {
+            f"b{j}": jax.tree.map(lambda *xs: jnp.stack(xs), *blocks[j::group_size])
+            for j in range(group_size)
+        }
+    else:
+        t["h_scan"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
     return {**params, "transformer": t}
 
 
 def unstack_block_params(params: dict, n_layer: int) -> dict:
     """Inverse of `stack_block_params`: split `transformer.h_scan` back into per-layer
-    subtrees (for generation, export, or loading into an unrolled model)."""
+    subtrees (for generation, export, or loading into an unrolled model). The grouped
+    layout is self-describing (`b{j}` keys), so no group_size argument is needed."""
     params = nn.unbox(params)
     t = dict(params["transformer"])
     stacked = t.pop("h_scan")
-    for i in range(n_layer):
-        t[f"h_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    if isinstance(stacked, dict) and "b0" in stacked:
+        group_size = len(stacked)
+        n_groups = n_layer // group_size
+        assert n_layer == n_groups * group_size, (n_layer, group_size)
+        for g in range(n_groups):
+            for j in range(group_size):
+                t[f"h_{g * group_size + j}"] = jax.tree.map(lambda x: x[g], stacked[f"b{j}"])
+    else:
+        for i in range(n_layer):
+            t[f"h_{i}"] = jax.tree.map(lambda x: x[i], stacked)
     return {**params, "transformer": t}
 
 
@@ -423,7 +512,7 @@ class GPTDolomiteForCausalLM(nn.Module):
             logits = jnp.dot(head_in, head_table.T)
         else:
             logits = self.lm_head(hidden_states)
-        logits = nn.with_logical_constraint(logits, ("act_batch", "act_seq", "act_vocab"))
+        logits = logical_constraint(logits, ("act_batch", "act_seq_inner", "act_vocab"))
         if self.config.m_width is not None:
             logits = logits / self.config.m_width
         return logits
