@@ -118,6 +118,27 @@ def _child(n: int, dp: int, fsdp: int, sp: int, tp: int) -> None:
         # mentions; fusion names like "all-reduce-fusion" are excluded by the word boundary
         counts[op] = len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo))
 
+    mem = compiled.memory_analysis()
+
+    # Evidence for the memory column: the largest PER-DEVICE buffers backing temp_size.
+    # Parse the buffer-assignment dump (enabled by the parent via --xla_dump_to) so a
+    # surprising peak_bytes can be attributed to a specific HLO value, not guessed at.
+    top_buffers = []
+    dump_dir = os.environ.get("_SCALING_REPORT_DUMP")
+    if dump_dir:
+        import glob as _glob
+
+        paths = _glob.glob(os.path.join(dump_dir, "*train_step*buffer-assignment*.txt"))
+        sized = []
+        if paths:
+            with open(sorted(paths)[-1]) as f:
+                for line in f:
+                    m = re.match(r"\s*allocation \d+: size (\d+)", line)
+                    if m:
+                        sized.append((int(m.group(1)), " ".join(line.split())[:160]))
+        sized.sort(key=lambda x: -x[0])
+        top_buffers = [line for _, line in sized[:5]]
+
     print(
         json.dumps(
             {
@@ -125,7 +146,10 @@ def _child(n: int, dp: int, fsdp: int, sp: int, tp: int) -> None:
                 "mesh": {"dp": dp, "fsdp": fsdp, "sp": sp, "tp": tp},
                 "compile_s": round(compile_s, 1),
                 "collectives": counts,
-                "peak_bytes": getattr(compiled.memory_analysis(), "temp_size_in_bytes", None),
+                "peak_bytes": getattr(mem, "temp_size_in_bytes", None),
+                "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
+                "output_bytes": getattr(mem, "output_size_in_bytes", None),
+                "top_temp_buffers": top_buffers,
             }
         )
     )
@@ -144,17 +168,22 @@ def main() -> None:
         _child(args.child, dp, fsdp, sp, tp)
         return
 
+    import tempfile
+
     results = []
     for n, dp, fsdp, sp, tp in MESHES:
         env = dict(os.environ)
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["JAX_PLATFORMS"] = "cpu"
+        dump_dir = tempfile.mkdtemp(prefix=f"scaling-dump-{n}-")
+        env["_SCALING_REPORT_DUMP"] = dump_dir
         flags = [
             f
             for f in env.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
+            if "xla_force_host_platform_device_count" not in f and "xla_dump" not in f
         ]
         flags.append(f"--xla_force_host_platform_device_count={n}")
+        flags.append(f"--xla_dump_to={dump_dir}")
         env["XLA_FLAGS"] = " ".join(flags)
         try:
             proc = subprocess.run(
@@ -171,6 +200,10 @@ def main() -> None:
             print(json.dumps(row), flush=True)
             results.append(row)
             continue
+        finally:
+            import shutil
+
+            shutil.rmtree(dump_dir, ignore_errors=True)
         line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
         if proc.returncode != 0 or not line.startswith("{"):
             row = {"devices": n, "error": (proc.stderr or proc.stdout)[-500:]}
