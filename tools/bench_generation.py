@@ -29,6 +29,13 @@ def main() -> None:
     p.add_argument("--new", type=int, default=128)
     p.add_argument("--impl", type=str, default="flash_attention_2")
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument(
+        "--seq2seq",
+        action="store_true",
+        help="bench enc_dec_dolomite decode instead: --prompt is the ENCODER length; the "
+        "short-prompt rerun sizes the cross-KV-precompute win (decode tokens/s should "
+        "barely depend on encoder length now that K/V are projected once)",
+    )
     args = p.parse_args()
 
     from dolomite_engine_tpu.enums import AttentionImplementation
@@ -39,37 +46,45 @@ def main() -> None:
     if backend != "tpu":  # tiny CPU fallback so the harness is always runnable
         args.n_embd, args.n_layer, args.prompt, args.new, args.batch = 128, 2, 48, 16, 2
 
-    config = config_from_dict(
-        dict(
-            model_type="gpt_dolomite",
-            vocab_size=50304 if backend == "tpu" else 512,
-            n_positions=args.prompt + args.new,
-            n_embd=args.n_embd,
-            n_layer=args.n_layer,
-            n_head=args.n_embd // 64,
-            num_key_value_heads=8 if backend == "tpu" else 2,
-            attention_head_type="gqa",
-            position_embedding_type="rope",
-            activation_function="swiglu",
-            normalization_function="rmsnorm",
-            add_bias=False,
-            resid_pdrop=0.0,
-            embd_pdrop=0.0,
-            attn_pdrop=0.0,
-        )
+    model_type = "enc_dec_dolomite" if args.seq2seq else "gpt_dolomite"
+    config_dict = dict(
+        model_type=model_type,
+        vocab_size=50304 if backend == "tpu" else 512,
+        n_positions=args.prompt + args.new,
+        n_embd=args.n_embd,
+        n_layer=args.n_layer,
+        n_head=args.n_embd // 64,
+        num_key_value_heads=8 if backend == "tpu" else 2,
+        attention_head_type="gqa",
+        position_embedding_type="rope",
+        activation_function="swiglu",
+        normalization_function="rmsnorm",
+        add_bias=False,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        bos_token_id=0,
+        eos_token_id=1,
+        pad_token_id=2,
     )
-    model = get_model_class("gpt_dolomite")(
+    config = config_from_dict(config_dict)
+    model = get_model_class(model_type)(
         config=config,
         dtype=jnp.bfloat16 if backend == "tpu" else jnp.float32,
-        attention_implementation=AttentionImplementation(args.impl),
+        attention_implementation=(
+            AttentionImplementation.sdpa if args.seq2seq else AttentionImplementation(args.impl)
+        ),
     )
 
     rng = jax.random.PRNGKey(0)
     ids = jnp.asarray(
-        np.random.RandomState(0).randint(0, config.vocab_size, (args.batch, args.prompt)),
+        np.random.RandomState(0).randint(3, config.vocab_size, (args.batch, args.prompt)),
         jnp.int32,
     )
-    params = model.init(rng, ids[:, :8])
+    if args.seq2seq:
+        params = model.init(rng, ids[:, :8], labels=ids[:, :4])
+    else:
+        params = model.init(rng, ids[:, :8])
     # left padding on half the rows exercises the mask -> segment-ids prefill path
     pad = args.prompt // 4
     mask = np.ones((args.batch, args.prompt), np.int32)
@@ -77,7 +92,13 @@ def main() -> None:
     ids = jnp.where(jnp.asarray(mask, bool), ids, config.pad_token_id)
     mask = jnp.asarray(mask)
 
-    gen = make_generate_fn(model, max_new_tokens=args.new, do_sample=False)
+    gen_kwargs = dict(max_new_tokens=args.new, do_sample=False)
+    if args.seq2seq:
+        # eos=None keeps every row decoding the full budget (pure throughput timing)
+        gen_kwargs.update(
+            is_encoder_decoder=True, decoder_start_token_id=0, pad_token_id=2, eos_token_id=None
+        )
+    gen = make_generate_fn(model, **gen_kwargs)
     out, _ = gen(params, ids, mask, rng)
     np.asarray(out)  # compile; host fetch — block_until_ready alone has proven unreliable
     # on the experimental axon platform for non-donated outputs (0.3ms "e2e" readings);
@@ -95,7 +116,7 @@ def main() -> None:
     # under-reports absolute prefill slightly; decode_tok_s likewise folds the short prefill
     # into the decode steps (a few percent at these shapes).
     short_len = min(128, max(args.prompt // 4, 8))
-    gen1 = make_generate_fn(model, max_new_tokens=args.new, do_sample=False)
+    gen1 = make_generate_fn(model, **gen_kwargs)
     ids1, mask1 = ids[:, :short_len], mask[:, :short_len]
     out, _ = gen1(params, ids1, mask1, rng)
     np.asarray(out)
@@ -110,7 +131,8 @@ def main() -> None:
         json.dumps(
             {
                 "backend": backend,
-                "impl": args.impl,
+                "model": model_type,
+                "impl": "sdpa" if args.seq2seq else args.impl,
                 "batch": args.batch,
                 "prompt": args.prompt,
                 "short_prompt": short_len,

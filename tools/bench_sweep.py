@@ -56,6 +56,8 @@ def main() -> None:
                    help="rnn_dolomite layer pattern over {a,d} (default: 'ad'*... mix)")
     p.add_argument("--offload", action="store_true",
                    help="cpu_offload: optimizer state in pinned_host memory (TPU only)")
+    p.add_argument("--scan", action="store_true",
+                   help="scan_layers: nn.scan over one block (or k-block groups with --ckpt k)")
     p.add_argument("--windows", type=int, default=1,
                    help="timing windows of --steps each; reports the median window")
     args = p.parse_args()
@@ -140,6 +142,7 @@ def main() -> None:
         reset_position_ids=args.packed,
         zero_stage=3,
         gradient_checkpointing_args=gc_args,
+        model_kwargs={"scan_layers": True} if args.scan else None,
     )
 
     sched = get_scheduler(10, 0, None, 1000, LRDecaySchedule.cosine, 0.1, base_lr=3e-4)
@@ -212,7 +215,7 @@ def main() -> None:
 
     print(json.dumps({
         "model": model_type, "n_embd": args.n_embd, "n_layer": args.n_layer,
-        "micro_bs": args.micro_bs,
+        "scan": args.scan, "micro_bs": args.micro_bs,
         "accum": args.accum, "ckpt": args.ckpt, "params_m": round(n_params / 1e6, 1),
         "mfu": round(mfu, 4), "step_ms": round(step_time * 1e3, 1),
         "win_ms": [round(w * 1e3, 1) for w in window_times],

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 follow-up measurements. Runs AFTER tools/tpu_measurement_queue.sh (the round-4
+# queue) exits — ONE TPU process at a time; a second claimant wedges the lease.
+#
+# Usage: bash tools/tpu_measurement_queue_r5.sh 2>&1 | tee /tmp/queue_r5.log
+cd /root/repo
+
+# wait for the r4 queue (if running) to finish: it owns the chip until it exits.
+# Anchored pattern (escaped dot + $) so neither this script's own cmdline nor a wrapper
+# shell / editor holding the path keeps the loop alive forever.
+while pgrep -f "bash /root/repo/tools/tpu_measurement_queue\.sh$" > /dev/null; do
+  sleep 120
+done
+
+SW="timeout 900 python tools/bench_sweep.py"
+
+# up to ~4h of additional patience in case the r4 queue exited on "TPU never recovered"
+for i in $(seq 1 120); do
+  if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
+    echo "=== TPU available for r5 queue at $(date)"
+
+    echo "=== r5 validation: bench.py driver config after sharding-rules activation"
+    DOLOMITE_BENCH_RETRIES=0 DOLOMITE_BENCH_DEADLINE=1100 timeout 1200 python bench.py 2>&1 | tail -1
+
+    echo "=== scan_layers compile A/B: unrolled 24L ckpt2+dots"
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 8 --fused_loss --splash --ckpt 2 --ckpt_policy dots_saveable --windows 2 --steps 5 2>&1 | tail -1
+    echo "=== scan_layers compile A/B: scanned 24L ckpt2+dots (grouped every-k)"
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 8 --fused_loss --splash --scan --ckpt 2 --ckpt_policy dots_saveable --windows 2 --steps 5 2>&1 | tail -1
+
+    echo "=== enc-dec decode: encoder 1920 (cross-KV precompute active)"
+    timeout 900 python tools/bench_generation.py --seq2seq --prompt 1920 --new 128 2>&1 | tail -1
+    echo "=== enc-dec decode: encoder 480 (dependence on S_enc should be weak)"
+    timeout 900 python tools/bench_generation.py --seq2seq --prompt 480 --new 128 2>&1 | tail -1
+
+    echo "=== r5 queue done at $(date)"
+    exit 0
+  fi
+  sleep 120
+done
+echo "TPU never recovered for r5 queue"
+exit 1
