@@ -88,6 +88,11 @@ def params_to_state_dict(config: CommonConfig, params: Any) -> dict[str, np.ndar
     # keyed to the one family whose param-tree layout these converters implement; other
     # registered enc-dec families need their own converter
     if config.model_type == "enc_dec_dolomite":
+        if "encoder_scan" in params:
+            # scan_layers checkpoint: unroll so the export layout matches unrolled models
+            from ..models.enc_dec_dolomite import unstack_enc_dec_params
+
+            params = unstack_enc_dec_params(params, config.n_encoder_layer, config.n_layer)
         return _enc_dec_params_to_state_dict(config, params)
 
     if "transformer" in params and "h_scan" in params["transformer"]:

@@ -92,11 +92,14 @@ class ModelWrapper:
                 "AutoModelForSeq2SeqLM, decoder-only families AutoModelForCausalLM"
             )
 
-        if self.model_kwargs.get("scan_layers") and self.model_type != "gpt_dolomite":
+        if self.model_kwargs.get("scan_layers") and self.model_type not in (
+            "gpt_dolomite",
+            "enc_dec_dolomite",
+        ):
             raise ValueError(
-                f"scan_layers supports gpt_dolomite only (got '{self.model_type}'): MoE "
-                "extras, per-group crosslayer, pattern-mixed RNN and enc-dec blocks cannot "
-                "ride one homogeneous scan"
+                f"scan_layers supports gpt_dolomite and enc_dec_dolomite (got "
+                f"'{self.model_type}'): MoE extras, per-group crosslayer and pattern-mixed "
+                "RNN blocks cannot ride one homogeneous scan"
             )
 
         self._setup_tokenizer(tokenizer_name, additional_special_tokens)
@@ -300,14 +303,23 @@ class ModelWrapper:
         if self.model_kwargs.get("scan_layers"):
             # checkpoints are stored unrolled (export unstacks); stack on load so the tree
             # matches the scanned model's shardings — symmetric with params_to_state_dict
-            from ..models.gpt_dolomite import scan_group_size, stack_block_params
+            if self.model_type == "enc_dec_dolomite":
+                from ..models.enc_dec_dolomite import stack_enc_dec_params
 
-            params = stack_block_params(
-                state_dict_to_params(self.config, manager),
-                self.config.n_layer,
-                # every-k remat under scan groups k blocks per scan step (BlockGroup layout)
-                group_size=scan_group_size(self.config.n_layer, self.checkpoint_every),
-            )
+                params = stack_enc_dec_params(
+                    state_dict_to_params(self.config, manager),
+                    self.config.n_encoder_layer,
+                    self.config.n_layer,
+                )
+            else:
+                from ..models.gpt_dolomite import scan_group_size, stack_block_params
+
+                params = stack_block_params(
+                    state_dict_to_params(self.config, manager),
+                    self.config.n_layer,
+                    # every-k remat under scan groups k blocks per step (BlockGroup layout)
+                    group_size=scan_group_size(self.config.n_layer, self.checkpoint_every),
+                )
             return jax.tree.map(jax.device_put, params, self.param_shardings(mesh))
         return state_dict_to_params(self.config, manager, mesh, self.param_shardings(mesh))
 
@@ -334,7 +346,12 @@ class ModelWrapper:
 
         assert not self.model_kwargs.get("scan_layers"), (
             "generation requires the unrolled model: convert the checkpoint with "
-            "models.gpt_dolomite.unstack_block_params and rebuild without scan_layers"
+            + (
+                "models.enc_dec_dolomite.unstack_enc_dec_params"
+                if self.is_encoder_decoder
+                else "models.gpt_dolomite.unstack_block_params"
+            )
+            + " and rebuild without scan_layers"
         )
         assert self.tokenizer is not None, "generation requires a tokenizer"
         if rng is None:

@@ -153,6 +153,12 @@ class EncDecDolomiteForSeq2SeqLM(nn.Module):
     dtype: Any = jnp.float32
     checkpoint_every: int = 0
     checkpoint_policy: str | None = None
+    # nn.scan over ONE encoder block and ONE decoder block instead of unrolling both stacks
+    # (same compile-time story as gpt_dolomite.scan_layers). Training path only; params get
+    # a leading stacked axis per stack — stack_enc_dec_params / unstack_enc_dec_params
+    # convert to/from the unrolled layout for loading/export. With checkpoint_every set,
+    # every block remats (the decoder's 3-branch blocks dominate memory anyway).
+    scan_layers: bool = False
 
     def setup(self) -> None:
         import dataclasses
@@ -176,39 +182,94 @@ class EncDecDolomiteForSeq2SeqLM(nn.Module):
         )
         dec_config = dataclasses.replace(config, init_residual_branches=3 * config.n_layer)
 
-        enc_blocks = []
-        for i in range(config.n_encoder_layer):
-            cls = Block
-            if self.checkpoint_every and i % self.checkpoint_every == 0:
-                # deterministic is positional arg 8 counting the module instance as 0
-                cls = nn.remat(cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy)
-            enc_blocks.append(
-                cls(
-                    config=enc_config,
-                    attention_implementation=self.attention_implementation,
-                    dtype=self.dtype,
-                    causal=False,
-                )
-            )
-        self.encoder = enc_blocks
-        self.ln_enc = get_norm(config, self.dtype)
+        if self.scan_layers:
+            from ..ops.fp8 import fp8_enabled
 
-        dec_blocks = []
-        for i in range(config.n_layer):
-            cls = EncDecBlock
-            if self.checkpoint_every and i % self.checkpoint_every == 0:
-                # deterministic / precompute_cross_kv are positional args 10 / 11
-                cls = nn.remat(
-                    cls, static_argnums=(10, 11), prevent_cse=False, policy=remat_policy
-                )
-            dec_blocks.append(
-                cls(
-                    config=dec_config,
-                    attention_implementation=self.attention_implementation,
-                    dtype=self.dtype,
-                )
+            assert not fp8_enabled(), (
+                "scan_layers with fp8 delayed-scaling state is not supported"
             )
-        self.decoder = dec_blocks
+            enc_cls, dec_cls = Block, EncDecBlock
+            if self.checkpoint_every > 1:
+                import logging
+
+                from ..utils import log_rank_0
+
+                log_rank_0(
+                    logging.WARNING,
+                    f"enc_dec scan_layers remats EVERY block; checkpoint_every="
+                    f"{self.checkpoint_every} (every-k-th) is not grouped here (unlike "
+                    "gpt_dolomite's BlockGroup) — expect the full-remat tradeoff",
+                )
+            if self.checkpoint_every:
+                enc_cls = nn.remat(
+                    enc_cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy
+                )
+                dec_cls = nn.remat(
+                    dec_cls, static_argnums=(10, 11), prevent_cse=False, policy=remat_policy
+                )
+            self.encoder_scan = nn.scan(
+                enc_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,) * 7,
+                length=config.n_encoder_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(
+                config=enc_config,
+                attention_implementation=self.attention_implementation,
+                dtype=self.dtype,
+                causal=False,
+            )
+            self.decoder_scan = nn.scan(
+                dec_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,) * 10,
+                length=config.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(
+                config=dec_config,
+                attention_implementation=self.attention_implementation,
+                dtype=self.dtype,
+            )
+            self.encoder = self.decoder = None
+        else:
+            enc_blocks = []
+            for i in range(config.n_encoder_layer):
+                cls = Block
+                if self.checkpoint_every and i % self.checkpoint_every == 0:
+                    # deterministic is positional arg 8 counting the module instance as 0
+                    cls = nn.remat(
+                        cls, static_argnums=(8,), prevent_cse=False, policy=remat_policy
+                    )
+                enc_blocks.append(
+                    cls(
+                        config=enc_config,
+                        attention_implementation=self.attention_implementation,
+                        dtype=self.dtype,
+                        causal=False,
+                    )
+                )
+            self.encoder = enc_blocks
+
+            dec_blocks = []
+            for i in range(config.n_layer):
+                cls = EncDecBlock
+                if self.checkpoint_every and i % self.checkpoint_every == 0:
+                    # deterministic / precompute_cross_kv are positional args 10 / 11
+                    cls = nn.remat(
+                        cls, static_argnums=(10, 11), prevent_cse=False, policy=remat_policy
+                    )
+                dec_blocks.append(
+                    cls(
+                        config=dec_config,
+                        attention_implementation=self.attention_implementation,
+                        dtype=self.dtype,
+                    )
+                )
+            self.decoder = dec_blocks
+
+        self.ln_enc = get_norm(config, self.dtype)
         self.ln_dec = get_norm(config, self.dtype)
 
         if not config.tie_word_embeddings:
@@ -276,6 +337,18 @@ class EncDecDolomiteForSeq2SeqLM(nn.Module):
             self.dtype,
         )
         enc_bias = None if self.rel_bias_enc is None else self.rel_bias_enc(seq, seq)
+        if self.scan_layers:
+            hidden_states, _ = self.encoder_scan(
+                hidden_states,
+                attention_mask,
+                None,  # segment_ids
+                rope_cos_sin,
+                enc_bias,
+                None,  # kv_cache
+                None,  # cache_index
+                deterministic,
+            )
+            return self.ln_enc(hidden_states)
         for block in self.encoder:
             hidden_states, _ = block(
                 hidden_states,
@@ -337,24 +410,45 @@ class EncDecDolomiteForSeq2SeqLM(nn.Module):
             else self.rel_bias_dec(seq, key_length, query_offset=offset)
         )
         new_caches = [] if kv_caches is not None else None
-        for i, block in enumerate(self.decoder):
-            hidden_states, cache = block(
+        if self.scan_layers:
+            assert kv_caches is None and cross_kv_caches is None and cache_index is None, (
+                "scan_layers is a training-path feature (caches would be silently "
+                "ignored); for generation convert the checkpoint with "
+                "unstack_enc_dec_params and rebuild without scan_layers"
+            )
+            hidden_states, _ = self.decoder_scan(
                 hidden_states,
                 encoder_hidden_states,
                 attention_mask,
-                None,  # decoder self-attention mask: causal handles it (right-padded labels
-                # only ever produce IGNORE_INDEX targets, so padded positions don't train)
+                None,  # decoder self-attention mask (causal handles right-padded labels)
                 rope_cos_sin,
                 dec_bias,
-                None if cross_kv_caches is None else cross_kv_caches[i],
-                None if kv_caches is None else kv_caches[i],
-                cache_index,
+                None,  # cross_kv (training recomputes inline once)
+                None,  # kv_cache
+                None,  # cache_index
                 deterministic,
-                False,  # static arg 11 (precompute_cross_kv) must be passed at EVERY site:
-                # nn.remat validates static_argnums against each call's actual arg count
+                False,  # precompute_cross_kv
             )
-            if new_caches is not None:
-                new_caches.append(cache)
+        else:
+            for i, block in enumerate(self.decoder):
+                hidden_states, cache = block(
+                    hidden_states,
+                    encoder_hidden_states,
+                    attention_mask,
+                    None,  # decoder self-attention mask: causal handles it (right-padded
+                    # labels only ever produce IGNORE_INDEX targets, so padded positions
+                    # don't train)
+                    rope_cos_sin,
+                    dec_bias,
+                    None if cross_kv_caches is None else cross_kv_caches[i],
+                    None if kv_caches is None else kv_caches[i],
+                    cache_index,
+                    deterministic,
+                    False,  # static arg 11 (precompute_cross_kv) must be passed at EVERY
+                    # site: nn.remat validates static_argnums against each call's arg count
+                )
+                if new_caches is not None:
+                    new_caches.append(cache)
         hidden_states = self.ln_dec(hidden_states)
 
         if config.tie_word_embeddings:
@@ -392,6 +486,9 @@ class EncDecDolomiteForSeq2SeqLM(nn.Module):
         wrapper reloaded from training args for generation): the flag is the static
         positional arg 11, and remat around this no-grad projection is a no-op.
         """
+        assert not self.scan_layers, (
+            "generation requires the unrolled model: convert with unstack_enc_dec_params"
+        )
         return [
             # (hidden, enc_h, enc_mask, attn_mask, rope, bias, cross_kv, kv_cache,
             #  cache_index, deterministic, precompute_cross_kv)
@@ -410,3 +507,27 @@ class EncDecDolomiteForSeq2SeqLM(nn.Module):
             {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
             for _ in range(config.n_layer)
         ]
+
+
+def stack_enc_dec_params(params: dict, n_encoder_layer: int, n_layer: int) -> dict:
+    """Unrolled `encoder_0..`/`decoder_0..` -> scanned `encoder_scan`/`decoder_scan` with a
+    leading stacked axis per stack (the layout `scan_layers=True` expects). Mirrors
+    `gpt_dolomite.stack_block_params`; unboxed trees in and out."""
+    params = dict(nn.unbox(params))
+    enc = [params.pop(f"encoder_{i}") for i in range(n_encoder_layer)]
+    dec = [params.pop(f"decoder_{i}") for i in range(n_layer)]
+    params["encoder_scan"] = jax.tree.map(lambda *xs: jnp.stack(xs), *enc)
+    params["decoder_scan"] = jax.tree.map(lambda *xs: jnp.stack(xs), *dec)
+    return params
+
+
+def unstack_enc_dec_params(params: dict, n_encoder_layer: int, n_layer: int) -> dict:
+    """Inverse of `stack_enc_dec_params` (for generation, export, or unrolled loading)."""
+    params = dict(nn.unbox(params))
+    enc = params.pop("encoder_scan")
+    dec = params.pop("decoder_scan")
+    for i in range(n_encoder_layer):
+        params[f"encoder_{i}"] = jax.tree.map(lambda x: x[i], enc)
+    for i in range(n_layer):
+        params[f"decoder_{i}"] = jax.tree.map(lambda x: x[i], dec)
+    return params
