@@ -386,8 +386,9 @@ class DistributedArgs(BaseArgs):
     communication_dtype: str | None = None
     # accepted no-op: XLA always compiles
     torch_compile: bool = False
-    # whether to use a dispatching dataloader (per-host sharded feed is the TPU default;
-    # flag accepted for config compat)
+    # single-host-storage mode: only process 0 reads the corpus; batches broadcast over
+    # the interconnect (data/dataloader.py DispatchingDataLoader). Default: per-host
+    # sharded feed (ShardedDataLoader), which is strictly better on shared storage
     dispatching_dataloader: bool = False
     # tensor parallel world size
     tensor_parallel_size: int = 1

@@ -16,7 +16,7 @@ import jax
 from ..enums import DatasetSplit, Mode
 from ..utils import log_rank_0
 from .base import BaseDataset, BlendedDatasets
-from .dataloader import ResumableDataLoader, ShardedDataLoader
+from .dataloader import DispatchingDataLoader, ResumableDataLoader, ShardedDataLoader
 from .debug import DebugDataset
 from .huggingface import HuggingFaceDataset, JSONLinesDataset, SST2Dataset
 from .instruction_tuning import AlpacaDataset, DollyDataset, SlimOrcaDataset
@@ -88,8 +88,12 @@ def get_dataloader(
     is_encoder_decoder: bool = False,
     mesh=None,
 ) -> ShardedDataLoader | None:
-    """Blended finetuning dataloader. Each host samples its own strided shard
-    (num_replicas = process_count); the ShardedDataLoader assembles global arrays."""
+    """Blended finetuning dataloader. Default: each host samples its own strided shard
+    (num_replicas = process_count) and the ShardedDataLoader assembles global arrays.
+    `distributed_args.dispatching_dataloader: true` (reference
+    `data/__init__.py:119-127`): only process 0 builds the datasets — workers without
+    corpus access skip straight to a DispatchingDataLoader that receives batches over the
+    interconnect."""
     assert mode == Mode.training, "blended dataset is only supported in training mode"
     # reference `_setup_tokenizer` hard-requires one ("pass a tokenizer",
     # model_wrapper/base.py:166); here the tokenizer is optional for megatron pretraining
@@ -99,20 +103,43 @@ def get_dataloader(
         "model_args.tokenizer_name"
     )
 
-    datasets_list, data_sampling_ratios = get_datasets_list(
-        dataset_args_list=args.datasets,
-        split=split,
-        mode=Mode.training,
-        tokenizer=tokenizer,
-        is_encoder_decoder=is_encoder_decoder,
-        num_virtual_tokens=args.tuning_args.get_num_virtual_tokens(),
-    )
-    if len(datasets_list) == 0:
+    dispatching = args.distributed_args.dispatching_dataloader
+    if dispatching:
+        assert mesh is not None, "dispatching_dataloader requires a mesh"
+
+    datasets_list, data_sampling_ratios = [], []
+    if not (dispatching and jax.process_index() != 0):
+        # worker processes in dispatching mode never touch storage — the mode's point
+        datasets_list, data_sampling_ratios = get_datasets_list(
+            dataset_args_list=args.datasets,
+            split=split,
+            mode=Mode.training,
+            tokenizer=tokenizer,
+            is_encoder_decoder=is_encoder_decoder,
+            num_virtual_tokens=args.tuning_args.get_num_virtual_tokens(),
+        )
+
+    if dispatching:
+        # availability must be agreed collectively: if process 0 has no data for this
+        # split it returns None and never joins the loader's broadcasts — workers
+        # returning a receiver here would deadlock at the first collective
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        has_data = int(
+            np.asarray(multihost_utils.broadcast_one_to_all(np.int32(len(datasets_list) > 0)))
+        )
+        if not has_data:
+            return None
+        if jax.process_index() != 0:
+            return DispatchingDataLoader(None, mesh)
+    elif len(datasets_list) == 0:
         return None
 
     blended_dataset = BlendedDatasets(datasets=datasets_list, split=split)
 
-    num_hosts = jax.process_count()
+    # dispatching: process 0 samples the WHOLE global batch; default: per-host shards
+    num_hosts = 1 if dispatching else jax.process_count()
     sampler = BlendedDistributedSampler(
         dataset=blended_dataset,
         data_sampling_ratios=[1] if len(datasets_list) == 1 else data_sampling_ratios,
@@ -162,6 +189,8 @@ def get_dataloader(
 
     if mesh is None:
         return local_loader
+    if dispatching:
+        return DispatchingDataLoader(local_loader, mesh)
     return ShardedDataLoader(local_loader, mesh)
 
 

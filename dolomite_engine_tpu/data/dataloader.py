@@ -1,16 +1,21 @@
-"""Dataloaders: resumable host-side batcher + global-array feeder.
+"""Dataloaders: resumable host-side batcher + global-array feeders.
 
 Parity: reference `dolomite_engine/data/dataloader.py:12-104`:
   - `ResumableDataLoader` (dataset+sampler state_dict) -> same here, minus torch.
-  - `DispatchingDataLoader` (node-rank0 loads batch x node_size, NCCL-broadcasts tensors, ranks
-    slice their shard) -> replaced by `ShardedDataLoader`: each HOST loads only its shard and
-    `jax.make_array_from_process_local_data` assembles the global sharded array — zero broadcast
-    traffic (the data never leaves the host that will feed those devices), which is strictly
-    better than dispatch-then-slice.
+  - `DispatchingDataLoader` (node-rank0 loads batch x node_size, NCCL-broadcasts tensors,
+    ranks slice their shard) -> two TPU answers:
+      * `ShardedDataLoader` (the default): each HOST loads only its shard and
+        `jax.make_array_from_process_local_data` assembles the global sharded array — zero
+        broadcast traffic; strictly better WHEN every host mounts the corpus.
+      * `DispatchingDataLoader` (`distributed_args.dispatching_dataloader: true`): only
+        process 0 touches storage; per-step batches ride a device-collective broadcast
+        (`multihost_utils.broadcast_one_to_all`, the XLA equivalent of the reference's
+        NCCL broadcast) — for single-host-storage setups, the reference's exact tradeoff.
 """
 
 from __future__ import annotations
 
+import json
 from typing import Callable, Iterator
 
 import jax
@@ -56,6 +61,152 @@ class ResumableDataLoader:
         self.dataset.load_state_dict(state_dict.get("dataset"))
         if self.sampler is not None:
             self.sampler.load_state_dict(state_dict.get("sampler"))
+
+
+class DispatchingDataLoader:
+    """Single-host-storage feed: ONLY process 0 owns a loader (and so reads the corpus);
+    every other process passes ``local_loader=None`` and never touches storage.
+
+    Per step, process 0 broadcasts a fixed-size int64 header (per-key dtype/shape, with a
+    sentinel for exhaustion) and then the batch arrays; receivers contribute zero-filled
+    placeholders of the header-announced shapes (``broadcast_one_to_all`` requires
+    matching structures on all processes). All hosts then hold the full global batch and
+    cut their devices' shards locally via ``make_array_from_callback`` — the
+    dispatch-then-slice layout of the reference's `DispatchingDataLoader`
+    (`data/dataloader.py:21-104`), with the NCCL broadcast replaced by an XLA device
+    collective. Key names/order ride a one-time JSON schema broadcast.
+    """
+
+    _SCHEMA_BYTES = 4096
+    _MAX_DIMS = 6
+    # 0 = key is None; bfloat16 via ml_dtypes (host batches are normally integer tokens)
+    _DTYPES = [None, np.int32, np.int64, np.float32, jax.numpy.bfloat16, np.bool_]
+
+    def __init__(self, local_loader, mesh, batch_axes: tuple[str, ...] = ("dp", "fsdp")) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        assert (local_loader is not None) == (jax.process_index() == 0), (
+            "process 0 must own the loader; every other process must pass None"
+        )
+        self.local_loader = local_loader
+        self.mesh = mesh
+        self.sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
+        self._keys: list[str] | None = None
+        self._length: int | None = None
+
+    # -------------------------------------------------------------- collective plumbing
+    @staticmethod
+    def _broadcast(tree):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(tree)
+
+    def _broadcast_schema(self, batch: dict | None) -> None:
+        """One-time, piggybacked on the FIRST real batch (no throwaway batch is ever
+        materialized): key order + loader length as a fixed-size byte buffer."""
+        if self._keys is not None:
+            return
+        if self.local_loader is not None:
+            payload = json.dumps(
+                {
+                    # batch None = the source is empty; receivers then stop immediately
+                    "keys": sorted(batch.keys()) if batch is not None else [],
+                    "len": len(self.local_loader),
+                }
+            )
+            raw = payload.encode()
+            assert len(raw) < self._SCHEMA_BYTES, "batch schema exceeds the schema buffer"
+            buf = np.zeros(self._SCHEMA_BYTES, np.uint8)
+            buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        else:
+            buf = np.zeros(self._SCHEMA_BYTES, np.uint8)
+        buf = np.asarray(self._broadcast(buf))
+        schema = json.loads(bytes(buf[buf != 0]).decode())
+        self._keys, self._length = schema["keys"], schema["len"]
+
+    def _header(self, batch: dict | None) -> np.ndarray:
+        """[n_keys, 1 + MAX_DIMS] int64: dtype code + padded shape; all -1 = exhausted."""
+        h = np.full((len(self._keys), 1 + self._MAX_DIMS), -1, np.int64)
+        if batch is not None:
+            for row, key in enumerate(self._keys):
+                value = batch.get(key)
+                if value is None:
+                    h[row, 0] = 0
+                    continue
+                value = np.asarray(value)
+                code = next(
+                    (
+                        i
+                        for i, dt in enumerate(self._DTYPES)
+                        if dt is not None and value.dtype == dt
+                    ),
+                    None,
+                )
+                if code is None:
+                    raise ValueError(
+                        f"DispatchingDataLoader cannot broadcast batch key '{key}' of "
+                        f"dtype {value.dtype}; supported: "
+                        f"{[np.dtype(dt).name for dt in self._DTYPES if dt is not None]}"
+                    )
+                h[row, 0] = code
+                h[row, 1 : 1 + value.ndim] = value.shape
+        return h
+
+    # -------------------------------------------------------------- iteration
+    def __iter__(self) -> Iterator:
+        source = iter(self.local_loader) if self.local_loader is not None else None
+        first = True
+        while True:
+            batch = next(source, None) if source is not None else None
+            if first:
+                self._broadcast_schema(batch)
+                first = False
+            header = np.asarray(self._broadcast(self._header(batch)))
+            if (header < 0).all():  # source exhausted -> every process stops this epoch
+                return
+            payload = []
+            for row, key in enumerate(self._keys):
+                code = int(header[row, 0])
+                if code <= 0:
+                    continue
+                shape = tuple(int(d) for d in header[row, 1:] if d >= 0)
+                if batch is not None:
+                    payload.append(np.asarray(batch[key], self._DTYPES[code]))
+                else:
+                    payload.append(np.zeros(shape, self._DTYPES[code]))
+            payload = self._broadcast(tuple(payload))
+            full = {}
+            it = iter(payload)
+            for row, key in enumerate(self._keys):
+                code = int(header[row, 0])
+                full[key] = None if code <= 0 else np.asarray(next(it))
+            yield {
+                key: (
+                    jax.make_array_from_callback(
+                        value.shape, self.sharding, lambda idx, v=value: v[idx]
+                    )
+                    if value is not None
+                    else None
+                )
+                for key, value in full.items()
+            }
+
+    def __len__(self) -> int:
+        if self.local_loader is not None:
+            return len(self.local_loader)
+        # a collective here could deadlock against a process that never calls len(), so
+        # receivers learn the true length only with the first epoch's schema broadcast;
+        # before that this is a length HINT (list() etc. call __len__ eagerly) — the train
+        # loops pace by step count, never by loader length
+        return self._length if self._length is not None else 0
+
+    def state_dict(self) -> dict:
+        # only the reading process has loader state; checkpoint writes happen on process 0
+        return self.local_loader.state_dict() if self.local_loader is not None else {}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        if self.local_loader is not None:
+            self.local_loader.load_state_dict(state_dict)
 
 
 class ShardedDataLoader:

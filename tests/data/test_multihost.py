@@ -117,3 +117,91 @@ def test_megatron_loader_respects_process_index(tmp_path, monkeypatch):
     global_rows = single.shape[0]
     assert two[0].shape[0] == global_rows // 2 and two[1].shape[0] == global_rows // 2
     np.testing.assert_array_equal(np.concatenate([two[0], two[1]], axis=0), single)
+
+
+class _FakeLoader:
+    """Deterministic stand-in for a ResumableDataLoader (dict batches, one None key)."""
+
+    def __init__(self, n=3, batch=8, seq=6):
+        self.n, self.batch, self.seq = n, batch, seq
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield {
+                "input_ids": np.full((self.batch, self.seq), i, np.int32),
+                "labels": np.full((self.batch, self.seq), 100 + i, np.int32),
+                "position_ids": None,
+            }
+
+    def __len__(self):
+        return self.n
+
+    def state_dict(self):
+        return {"cursor": 7}
+
+    def load_state_dict(self, sd):
+        self.loaded = sd
+
+
+def test_dispatching_loader_single_process():
+    """process_count=1 degenerate case: the broadcast is an identity and the yielded
+    global arrays match the source batches exactly (incl. the None key and termination)."""
+    from dolomite_engine_tpu.data.dataloader import DispatchingDataLoader
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    MeshManager.destroy()
+    MeshManager(data_parallel_sharding_world_size=4)
+    try:
+        mesh = MeshManager.get_mesh()
+        loader = DispatchingDataLoader(_FakeLoader(), mesh)
+        assert len(loader) == 3
+        seen = list(loader)
+        assert len(seen) == 3
+        for i, batch in enumerate(seen):
+            assert batch["position_ids"] is None
+            np.testing.assert_array_equal(np.asarray(batch["input_ids"]), np.full((8, 6), i))
+            np.testing.assert_array_equal(np.asarray(batch["labels"]), np.full((8, 6), 100 + i))
+            assert batch["input_ids"].sharding.spec == jax.sharding.PartitionSpec(("dp", "fsdp"))
+        assert loader.state_dict() == {"cursor": 7}
+    finally:
+        MeshManager.destroy()
+
+
+def test_dispatching_loader_receiver_lockstep(monkeypatch):
+    """Simulated 2-process run: a stubbed broadcast carries the source's buffers to a
+    receiver built with local_loader=None (never touches a dataset); both sides yield
+    identical batches and stop together."""
+    from dolomite_engine_tpu.data import dataloader as dl
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    MeshManager.destroy()
+    MeshManager(data_parallel_sharding_world_size=4)
+    try:
+        mesh = MeshManager.get_mesh()
+        source = dl.DispatchingDataLoader(_FakeLoader(), mesh)
+
+        channel = []
+        monkeypatch.setattr(
+            dl.DispatchingDataLoader, "_broadcast", staticmethod(lambda t: (channel.append(t), t)[1])
+        )
+
+        src_batches = list(source)
+
+        # receiver: replay the recorded collective traffic in order
+        replay = iter(channel)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        monkeypatch.setattr(
+            dl.DispatchingDataLoader, "_broadcast", staticmethod(lambda t: next(replay))
+        )
+        receiver = dl.DispatchingDataLoader(None, mesh)
+        rec_batches = list(receiver)
+        assert len(receiver) == 3
+
+        assert len(rec_batches) == len(src_batches) == 3
+        for s, r in zip(src_batches, rec_batches):
+            assert r["position_ids"] is None
+            np.testing.assert_array_equal(np.asarray(s["input_ids"]), np.asarray(r["input_ids"]))
+            np.testing.assert_array_equal(np.asarray(s["labels"]), np.asarray(r["labels"]))
+        assert receiver.state_dict() == {}
+    finally:
+        MeshManager.destroy()
