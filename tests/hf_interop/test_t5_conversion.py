@@ -190,6 +190,56 @@ def test_lora_seq2seq_generation_paths(tmp_path):
     assert all(0 < int(n) <= 4 for n in num_generated)
 
 
+@pytest.mark.parametrize("v1_1", [False, True], ids=["t5_v1_0", "t5_v1_1"])
+def test_t5_greedy_decode_parity(tmp_path, v1_1):
+    """Greedy decode against HF T5.generate: exercises the relative-bias bucketing under a
+    TRACED cache offset, the self-attention KV cache, and the cross-KV precompute — the
+    paths teacher-forced logit parity never touches."""
+    import jax
+
+    from dolomite_engine_tpu.generation_utils import generate_seq2seq_tokens
+
+    hf_model, hf_path = _tiny_t5(tmp_path, v1_1=v1_1)
+    dolomite_path = str(tmp_path / "dolomite")
+    import_from_huggingface(hf_path, dolomite_path)
+
+    config = config_from_dict(json.load(open(os.path.join(dolomite_path, "config.json"))))
+    model = get_model_class(config.model_type)(config=config)
+    params = {"params": state_dict_to_params(config, SafeTensorsWeightsManager(dolomite_path))}
+
+    ids, mask, _ = _batch(np.random.RandomState(3))
+    new_tokens = 10
+    with torch.no_grad():
+        ref = hf_model.generate(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            num_beams=1,
+        )
+    # HF prepends decoder_start and stops at EOS; compare the generated continuation
+    ref_tokens = ref[:, 1:].numpy()
+
+    generated, num_generated = generate_seq2seq_tokens(
+        model,
+        params,
+        jnp.asarray(ids, jnp.int32),
+        jnp.asarray(mask, jnp.int32),
+        jax.random.PRNGKey(0),
+        max_new_tokens=new_tokens,
+        do_sample=False,
+        eos_token_id=1,
+        pad_token_id=0,
+        decoder_start_token_id=0,
+    )
+    generated = np.asarray(generated)
+    for row in range(ids.shape[0]):
+        n = min(int(num_generated[row]), ref_tokens.shape[1])
+        np.testing.assert_array_equal(
+            generated[row, :n], ref_tokens[row, :n], err_msg=f"row {row}"
+        )
+
+
 def test_seq2seq_generation_with_checkpointed_model():
     """Generation on an enc-dec model built WITH gradient checkpointing (a wrapper reloaded
     from training args keeps checkpoint_every set): cross-KV precompute must route through
