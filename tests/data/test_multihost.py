@@ -205,3 +205,23 @@ def test_dispatching_loader_receiver_lockstep(monkeypatch):
         assert receiver.state_dict() == {}
     finally:
         MeshManager.destroy()
+
+
+def test_dispatching_loader_rejects_unsupported_dtype():
+    """An unsupported batch dtype must fail loudly, naming the key and dtype (not an
+    opaque generator StopIteration)."""
+    from dolomite_engine_tpu.data.dataloader import DispatchingDataLoader
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    class _BadLoader(_FakeLoader):
+        def __iter__(self):
+            yield {"weights": np.ones((8, 6), np.float64)}
+
+    MeshManager.destroy()
+    MeshManager(data_parallel_sharding_world_size=4)
+    try:
+        loader = DispatchingDataLoader(_BadLoader(), MeshManager.get_mesh())
+        with pytest.raises(ValueError, match="weights.*float64"):
+            next(iter(loader))
+    finally:
+        MeshManager.destroy()
