@@ -12,6 +12,7 @@ import logging
 from functools import partial
 
 import jax
+import numpy as np
 
 from ..enums import DatasetSplit, Mode
 from ..utils import log_rank_0
@@ -123,7 +124,6 @@ def get_dataloader(
         # availability must be agreed collectively: if process 0 has no data for this
         # split it returns None and never joins the loader's broadcasts — workers
         # returning a receiver here would deadlock at the first collective
-        import numpy as np
         from jax.experimental import multihost_utils
 
         has_data = int(

@@ -14,6 +14,7 @@ model class and TP is a sharding-rule choice, so no swap exists.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import Any
 
@@ -200,8 +201,6 @@ class ModelWrapper:
         under an ep mesh). Under no global mesh (single-chip tests, generation) the bound
         constraints remain no-ops, so this only affects mesh-scoped programs.
         """
-        import contextlib
-
         stack = contextlib.ExitStack()
         stack.enter_context(self.fp8_scope())
         stack.enter_context(nn.logical_axis_rules(self.sharding_rules()))

@@ -58,20 +58,23 @@ class LoRACausalLM(nn.Module):
     dropout: float = 0.1
     targets: tuple[str, ...] = ("c_attn",)
 
+    def _scope(self):
+        return lora_scope(self.rank, self.alpha, self.dropout, self.targets)
+
     @nn.compact
     def __call__(self, *args, **kwargs):
-        with lora_scope(self.rank, self.alpha, self.dropout, self.targets):
+        with self._scope():
             return self.base_model(*args, **kwargs)
 
     # seq2seq generation entry points (generation_utils.generate_seq2seq_tokens calls these
     # via apply(method=...)); the LoRA scope must wrap them too or the encoder / cross-KV
     # projections would silently run without their adapters
     def encode(self, *args, **kwargs):
-        with lora_scope(self.rank, self.alpha, self.dropout, self.targets):
+        with self._scope():
             return self.base_model.encode(*args, **kwargs)
 
     def precompute_cross_kv(self, *args, **kwargs):
-        with lora_scope(self.rank, self.alpha, self.dropout, self.targets):
+        with self._scope():
             return self.base_model.precompute_cross_kv(*args, **kwargs)
 
     @property

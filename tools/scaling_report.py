@@ -20,6 +20,7 @@ import argparse
 import json
 import os
 import re
+import shutil
 import subprocess
 import sys
 
@@ -201,8 +202,6 @@ def main() -> None:
             results.append(row)
             continue
         finally:
-            import shutil
-
             shutil.rmtree(dump_dir, ignore_errors=True)
         line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
         if proc.returncode != 0 or not line.startswith("{"):
