@@ -223,9 +223,17 @@ class SaveArgs(BaseArgs):
     # reference): the device->host copy is synchronous, the serialization+write runs in a
     # background thread; the `latest` pointer is only advanced once the write commits
     async_checkpointing: bool = False
+    # retention: after each COMMITTED save, prune global_step* dirs beyond the newest N;
+    # the checkpoint named by the `latest` pointer is never deleted. None keeps everything
+    # (current behavior)
+    keep_last_n: int | None = None
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None([(self.save_path, "save_path"), (self.save_interval, "save_interval")])
+
+        assert self.keep_last_n is None or self.keep_last_n >= 1, (
+            f"keep_last_n must be >= 1 (got {self.keep_last_n}); use None to keep everything"
+        )
 
 
 class LoadArgs(BaseArgs):
@@ -476,6 +484,41 @@ class ResearchArgs(BaseArgs):
     neft_alpha: float | None = None
 
 
+class FaultToleranceArgs(BaseArgs):
+    """Long-run survival knobs (no reference counterpart — the reference engine dies on the
+    first SIGTERM, NaN step, or storage blip). Defaults preserve prior behavior except
+    preemption handling, which is purely additive: it only changes what happens when the
+    process is being killed anyway."""
+
+    # SIGTERM/SIGINT (TPU maintenance-event and spot-reclaim notices, ^C) trigger a final
+    # synchronous checkpoint at the end of the current step and a clean exit
+    preemption_checkpointing: bool = True
+    # skip the optimizer update on steps whose loss or grad-norm is non-finite (lax.cond
+    # inside the jitted step returns params/opt-state unchanged); costs one host sync per
+    # step for the skip counter
+    skip_nonfinite_steps: bool = False
+    # abort the run after this many CONSECUTIVE skipped steps (divergence, bad data shard)
+    max_consecutive_nonfinite_steps: int = 10
+    # wall-clock seconds one next(train_dataloader) may take before the run aborts with a
+    # clear error instead of hanging forever; None disables the watchdog
+    dataloader_stall_timeout_seconds: float | None = None
+    # bounded exponential backoff for checkpoint save/load and `latest`-pointer I/O
+    # (transient GCS/NFS errors): total tries, initial delay, delay cap
+    checkpoint_io_attempts: int = 3
+    checkpoint_io_backoff_seconds: float = 1.0
+    checkpoint_io_max_backoff_seconds: float = 30.0
+
+    def model_post_init(self, __context: Any) -> None:
+        assert self.max_consecutive_nonfinite_steps >= 1, (
+            "max_consecutive_nonfinite_steps must be >= 1"
+        )
+        assert self.checkpoint_io_attempts >= 1, "checkpoint_io_attempts must be >= 1"
+        assert (
+            self.dataloader_stall_timeout_seconds is None
+            or self.dataloader_stall_timeout_seconds > 0
+        ), "dataloader_stall_timeout_seconds must be positive or None"
+
+
 class TrainingArgs(BaseArgs):
     # randomization related arguments
     random_args: RandomArgs = RandomArgs()
@@ -505,6 +548,8 @@ class TrainingArgs(BaseArgs):
     distributed_args: DistributedArgs = DistributedArgs()
     # research args
     research_args: ResearchArgs = ResearchArgs()
+    # fault tolerance: preemption checkpointing, NaN/stall guards, checkpoint I/O retry
+    fault_tolerance_args: FaultToleranceArgs = FaultToleranceArgs()
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(

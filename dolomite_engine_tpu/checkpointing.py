@@ -23,6 +23,12 @@ load_experiments_tracker_state / load_starting_iteration / resume_learning_rate.
 `resume_learning_rate` (reference `_resume_learning_rate` 419-445): optax schedules are pure
 functions of the step count inside opt_state; resuming the LR = restoring opt_state + step
 (default), NOT resuming it = zeroing the schedule step after restore.
+
+Fault tolerance (docs/FAULT_TOLERANCE.md): every durable-path operation — orbax
+save/restore, the `latest` pointer read/write, metadata probes — retries transient I/O
+errors with bounded backoff (`FaultToleranceArgs.checkpoint_io_*`); the pointer only
+advances after an integrity check of the written state dir; `SaveArgs.keep_last_n` prunes
+old checkpoints at commit time, never the `latest`-pointed one.
 """
 
 from __future__ import annotations
@@ -31,6 +37,8 @@ import json
 import logging
 import os
 import random
+import re
+import shutil
 from typing import Any
 
 import jax
@@ -41,10 +49,35 @@ import orbax.checkpoint as ocp
 from .arguments import InferenceArgs, TrainingArgs, UnshardingArgs, args_from_dict
 from .enums import Mode
 from .train_utils import TrainState
-from .utils import ExperimentsTracker, load_yaml, log_rank_0
+from .utils import ExperimentsTracker, load_yaml, log_rank_0, retry_io
 
 _TRAINING_CONFIG = "training_config.yml"
 _LATEST = "latest_checkpointed_iteration.json"
+_CHECKPOINT_DIR_RE = re.compile(r"global_step(\d+)")
+
+
+def _retry_kwargs(args) -> dict:
+    """Checkpoint-I/O retry policy from FaultToleranceArgs; defaults when the args tree has
+    none (InferenceArgs/UnshardingArgs, or config snapshots predating the block)."""
+    ft = getattr(args, "fault_tolerance_args", None)
+    if ft is None:
+        return {}
+    return dict(
+        attempts=ft.checkpoint_io_attempts,
+        base_delay_seconds=ft.checkpoint_io_backoff_seconds,
+        max_delay_seconds=ft.checkpoint_io_max_backoff_seconds,
+    )
+
+
+def _read_latest_iteration(path: str, retry_kwargs: dict | None = None) -> int:
+    """The `latest`-pointer read, retried: on network filesystems this tiny read is the
+    single point of failure for EVERY resume."""
+
+    def _read() -> int:
+        with open(os.path.join(path, _LATEST)) as f:
+            return json.load(f)["latest_checkpointed_iteration"]
+
+    return retry_io(_read, description=f"read {_LATEST}", **(retry_kwargs or {}))
 
 
 def _get_checkpoint_tag(iteration: int) -> str:
@@ -102,9 +135,10 @@ def set_rng_state(state: dict) -> jax.Array | None:
 # device->host synchronously inside save() and runs serialization + disk writes on a
 # background thread; reusing one instance lets consecutive saves pipeline
 _CHECKPOINTER: ocp.StandardCheckpointer | None = None
-# (save_path, iteration) of a started-but-not-yet-committed async save; its `latest`
-# pointer is written by finish_pending_checkpoint() once the write is durable
-_PENDING: tuple[str, int] | None = None
+# (save_path, iteration, retry_kwargs, keep_last_n) of a started-but-not-yet-committed
+# async save; its `latest` pointer is written by finish_pending_checkpoint() once the
+# write is durable
+_PENDING: tuple[str, int, dict, int | None] | None = None
 
 
 def _get_checkpointer() -> ocp.StandardCheckpointer:
@@ -119,16 +153,85 @@ def finish_pending_checkpoint() -> None:
 
     Called at the start of the next save (so at most one save is in flight), at the end of
     training, and before any in-process restore. Crash-safety: the pointer is only written
-    after `wait_until_finished`, so `latest` can never name a torn checkpoint — a crash
-    mid-write loses at most the in-flight save, never the previous one.
+    after `wait_until_finished` AND an integrity check of the written state dir, so `latest`
+    can never name a torn checkpoint — a crash mid-write loses at most the in-flight save,
+    never the previous one.
     """
     global _PENDING
     if _PENDING is None:
         return
-    save_path, iteration = _PENDING
+    save_path, iteration, retry_kwargs, keep_last_n = _PENDING
     _PENDING = None
-    _get_checkpointer().wait_until_finished()
-    _write_latest(save_path, iteration)
+    retry_io(
+        _get_checkpointer().wait_until_finished,
+        description="async checkpoint write",
+        **retry_kwargs,
+    )
+    _commit_checkpoint(save_path, iteration, retry_kwargs, keep_last_n)
+
+
+def _validate_checkpoint(base: str) -> None:
+    """Integrity gate before the `latest` pointer may name `base`: the orbax state dir must
+    exist and its metadata must be readable (i.e. the checkpoint is restorable-shaped).
+    Catches torn/partial writes that a crash or flaky mount left behind."""
+    state_path = _state_path(base)
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(
+            f"checkpoint state dir missing at {state_path} — torn or incomplete save"
+        )
+    tree = _checkpoint_tree_metadata(os.path.abspath(state_path))
+    if tree is None or (hasattr(tree, "__len__") and len(tree) == 0):
+        raise ValueError(
+            f"checkpoint at {base} has unreadable/empty state metadata — refusing to "
+            "advance the latest pointer to it"
+        )
+
+
+def _commit_checkpoint(
+    save_path: str, iteration: int, retry_kwargs: dict, keep_last_n: int | None
+) -> None:
+    """Validate -> advance `latest` -> prune old checkpoints, each with bounded retry."""
+    retry_io(
+        lambda: _validate_checkpoint(_get_base_path(save_path, iteration)),
+        description=f"validate global_step{iteration}",
+        **retry_kwargs,
+    )
+    retry_io(
+        lambda: _write_latest(save_path, iteration),
+        description=f"write {_LATEST}",
+        **retry_kwargs,
+    )
+    _prune_old_checkpoints(save_path, keep_last_n)
+
+
+def _prune_old_checkpoints(save_path: str, keep_last_n: int | None) -> None:
+    """Retention: keep the newest `keep_last_n` global_step* dirs, NEVER deleting the one
+    named by `latest` (which may be older after a rollback-resume). Best-effort — a prune
+    failure must not kill training over disk housekeeping."""
+    if keep_last_n is None or not _is_primary():
+        return
+    try:
+        latest_iteration = None
+        if os.path.isfile(os.path.join(save_path, _LATEST)):
+            latest_iteration = _read_latest_iteration(save_path, {"attempts": 1})
+        iterations = sorted(
+            int(m.group(1))
+            for name in os.listdir(save_path)
+            if (m := _CHECKPOINT_DIR_RE.fullmatch(name))
+            and os.path.isdir(os.path.join(save_path, name))
+        )
+        keep = set(iterations[-keep_last_n:])
+        if latest_iteration is not None:
+            keep.add(latest_iteration)
+        for iteration in iterations:
+            if iteration not in keep:
+                shutil.rmtree(_get_base_path(save_path, iteration), ignore_errors=True)
+                log_rank_0(
+                    logging.INFO,
+                    f"pruned checkpoint global_step{iteration} (keep_last_n={keep_last_n})",
+                )
+    except OSError as error:
+        log_rank_0(logging.WARNING, f"checkpoint pruning skipped: {error!r}")
 
 
 def _write_latest(save_path: str, iteration: int) -> None:
@@ -163,6 +266,8 @@ def save_checkpoint(
     """Save a full training checkpoint (reference `save_checkpoint`, checkpointing.py:50-146)."""
     save_path = args.save_args.save_path
     is_async = bool(getattr(args.save_args, "async_checkpointing", False))
+    keep_last_n = getattr(args.save_args, "keep_last_n", None)
+    retry_kwargs = _retry_kwargs(args)
     finish_pending_checkpoint()  # at most one save in flight
     base = _get_base_path(save_path, iteration)
     os.makedirs(base, exist_ok=True)
@@ -172,9 +277,17 @@ def save_checkpoint(
         to_save = TrainState(step=state.step, params=state.params, opt_state=(), fp8=state.fp8)
 
     checkpointer = _get_checkpointer()
-    checkpointer.save(os.path.abspath(_state_path(base)), to_save, force=True)
+    retry_io(
+        lambda: checkpointer.save(os.path.abspath(_state_path(base)), to_save, force=True),
+        description=f"start checkpoint save global_step{iteration}",
+        **retry_kwargs,
+    )
     if not is_async:
-        checkpointer.wait_until_finished()
+        retry_io(
+            checkpointer.wait_until_finished,
+            description=f"checkpoint write global_step{iteration}",
+            **retry_kwargs,
+        )
 
     rng_path = os.path.join(base, f"rng_state-{jax.process_index()}.json")
     with open(rng_path, "w") as f:
@@ -199,9 +312,10 @@ def save_checkpoint(
 
     if is_async:
         global _PENDING
-        _PENDING = (save_path, iteration)  # `latest` advances once the write commits
+        # `latest` advances (and old checkpoints are pruned) once the write commits
+        _PENDING = (save_path, iteration, retry_kwargs, keep_last_n)
     else:
-        _write_latest(save_path, iteration)
+        _commit_checkpoint(save_path, iteration, retry_kwargs, keep_last_n)
 
     log_rank_0(logging.INFO, f"checkpoint saved at {base}" + (" (async)" if is_async else ""))
 
@@ -270,13 +384,12 @@ def load_checkpoint_for_training(
         return state, 0, None, None
 
     finish_pending_checkpoint()  # an in-flight async save may be the one being restored
+    retry_kwargs = _retry_kwargs(args)
     load_path = load_args.load_path
     if iteration is None:
         iteration = load_args.iteration
     if iteration is None:
-        latest_file = os.path.join(load_path, _LATEST)
-        with open(latest_file) as f:
-            iteration = json.load(f)["latest_checkpointed_iteration"]
+        iteration = _read_latest_iteration(load_path, retry_kwargs)
 
     base = _get_base_path(load_path, iteration)
 
@@ -290,12 +403,17 @@ def load_checkpoint_for_training(
     # the live state (bf16 resume of an fp8 save) — restore it only when both sides have it
     restore_fp8 = state.fp8 is not None and len(_tree_subtree_keys(tree_meta, "fp8")) > 0
 
+    def _restore_with_retry(fn, what: str):
+        return retry_io(fn, description=what, **retry_kwargs)
+
     if not load_args.load_optimizer:
         # params-only partial restore; keep the freshly-initialized opt_state
         want = {"step": abstract.step, "params": abstract.params}
         if restore_fp8:
             want["fp8"] = abstract.fp8
-        restored_sub = _partial_restore(state_path, want)
+        restored_sub = _restore_with_retry(
+            lambda: _partial_restore(state_path, want), "params-only checkpoint restore"
+        )
         restored = TrainState(
             step=restored_sub["step"],
             params=restored_sub["params"],
@@ -309,16 +427,22 @@ def load_checkpoint_for_training(
                 "resume it with load_args.load_optimizer=false"
             )
         if state.fp8 is None or restore_fp8:
-            restored = ocp.StandardCheckpointer().restore(state_path, abstract)
+            restored = _restore_with_retry(
+                lambda: ocp.StandardCheckpointer().restore(state_path, abstract),
+                "full checkpoint restore",
+            )
         else:
             # checkpoint has no fp8 subtree: restore the rest, keep the fresh fp8 state
-            restored_sub = _partial_restore(
-                state_path,
-                {
-                    "step": abstract.step,
-                    "params": abstract.params,
-                    "opt_state": abstract.opt_state,
-                },
+            restored_sub = _restore_with_retry(
+                lambda: _partial_restore(
+                    state_path,
+                    {
+                        "step": abstract.step,
+                        "params": abstract.params,
+                        "opt_state": abstract.opt_state,
+                    },
+                ),
+                "no-fp8 checkpoint restore",
             )
             restored = TrainState(
                 step=restored_sub["step"],
@@ -386,11 +510,9 @@ def get_experiments_tracker_checkpoint_metadata(args: TrainingArgs) -> dict:
         return {}
     iteration = load_args.iteration
     if iteration is None:
-        latest = os.path.join(load_args.load_path, _LATEST)
-        if not os.path.isfile(latest):
+        if not os.path.isfile(os.path.join(load_args.load_path, _LATEST)):
             return {}
-        with open(latest) as f:
-            iteration = json.load(f)["latest_checkpointed_iteration"]
+        iteration = _read_latest_iteration(load_args.load_path, _retry_kwargs(args))
     tracker_path = os.path.join(
         _get_base_path(load_args.load_path, iteration), "experiments_tracker.json"
     )
@@ -420,8 +542,7 @@ def load_checkpoint_for_inference(
     load_path = load_args.load_path
     iteration = load_args.iteration
     if iteration is None:
-        with open(os.path.join(load_path, _LATEST)) as f:
-            iteration = json.load(f)["latest_checkpointed_iteration"]
+        iteration = _read_latest_iteration(load_path, _retry_kwargs(args))
     base = _get_base_path(load_path, iteration)
 
     training_args = args_from_dict(load_yaml(os.path.join(base, _TRAINING_CONFIG)), Mode.training)
@@ -448,6 +569,10 @@ def load_checkpoint_for_inference(
     abstract_params = jax.tree.map(
         _abstract, params_meta, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
     )
-    restored = _partial_restore(state_path, {"params": abstract_params})
+    restored = retry_io(
+        lambda: _partial_restore(state_path, {"params": abstract_params}),
+        description="inference params restore",
+        **_retry_kwargs(args),
+    )
 
     return model, restored["params"], training_args

@@ -108,8 +108,10 @@ class BaseDataset:
 
         prompt = self._tokenize(input)
         if budget_in is not None:
-            # the encoder-decoder budget already excludes the EOS appended below
-            # (get_max_input_length), so truncation leaves room for it either way
+            # enc-dec: get_max_input_length already reserved one slot for the EOS appended
+            # below, yet we subtract 1 AGAIN — deliberately mirroring the reference
+            # implementation's double reservation (parity quirk, one token shorter than
+            # strictly necessary)
             keep = budget_in - 1 if self.is_encoder_decoder else budget_in
             del prompt[keep:]
         if self.is_encoder_decoder:

@@ -67,7 +67,7 @@ class DispatchingDataLoader:
     """Single-host-storage feed: ONLY process 0 owns a loader (and so reads the corpus);
     every other process passes ``local_loader=None`` and never touches storage.
 
-    Per step, process 0 broadcasts a fixed-size int64 header (per-key dtype/shape, with a
+    Per step, process 0 broadcasts a fixed-size int32 header (per-key dtype/shape, with a
     sentinel for exhaustion) and then the batch arrays; receivers contribute zero-filled
     placeholders of the header-announced shapes (``broadcast_one_to_all`` requires
     matching structures on all processes). All hosts then hold the full global batch and
@@ -79,8 +79,11 @@ class DispatchingDataLoader:
 
     _SCHEMA_BYTES = 4096
     _MAX_DIMS = 6
-    # 0 = key is None; bfloat16 via ml_dtypes (host batches are normally integer tokens)
-    _DTYPES = [None, np.int32, np.int64, np.float32, jax.numpy.bfloat16, np.bool_]
+    # 0 = key is None; bfloat16 via ml_dtypes (host batches are normally integer tokens).
+    # int64 is NOT here: broadcast_one_to_all silently downcasts it to int32 under JAX's
+    # default x64-disabled mode — int64 batches are range-checked and cast to int32 on the
+    # sending side (_header) instead, so header dtype and delivered dtype always agree.
+    _DTYPES = [None, np.int32, np.float32, jax.numpy.bfloat16, np.bool_]
 
     def __init__(self, local_loader, mesh, batch_axes: tuple[str, ...] = ("dp", "fsdp")) -> None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -92,7 +95,13 @@ class DispatchingDataLoader:
         self.mesh = mesh
         self.sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
         self._keys: list[str] | None = None
-        self._length: int | None = None
+        # length broadcast EAGERLY: __init__ runs on every process (a collective here can't
+        # deadlock), and len() must be correct on receivers BEFORE the first epoch's schema
+        # broadcast — callers size progress bars and schedules off it
+        length = np.asarray(
+            [len(local_loader) if local_loader is not None else 0], np.int32
+        )
+        self._length = int(np.asarray(self._broadcast(length))[0])
 
     # -------------------------------------------------------------- collective plumbing
     @staticmethod
@@ -103,7 +112,8 @@ class DispatchingDataLoader:
 
     def _broadcast_schema(self, batch: dict | None) -> None:
         """One-time, piggybacked on the FIRST real batch (no throwaway batch is ever
-        materialized): key order + loader length as a fixed-size byte buffer."""
+        materialized): key order as a fixed-size byte buffer (length already rode the
+        eager __init__ broadcast)."""
         if self._keys is not None:
             return
         if self.local_loader is not None:
@@ -111,7 +121,6 @@ class DispatchingDataLoader:
                 {
                     # batch None = the source is empty; receivers then stop immediately
                     "keys": sorted(batch.keys()) if batch is not None else [],
-                    "len": len(self.local_loader),
                 }
             )
             raw = payload.encode()
@@ -121,12 +130,13 @@ class DispatchingDataLoader:
         else:
             buf = np.zeros(self._SCHEMA_BYTES, np.uint8)
         buf = np.asarray(self._broadcast(buf))
-        schema = json.loads(bytes(buf[buf != 0]).decode())
-        self._keys, self._length = schema["keys"], schema["len"]
+        self._keys = json.loads(bytes(buf[buf != 0]).decode())["keys"]
 
     def _header(self, batch: dict | None) -> np.ndarray:
-        """[n_keys, 1 + MAX_DIMS] int64: dtype code + padded shape; all -1 = exhausted."""
-        h = np.full((len(self._keys), 1 + self._MAX_DIMS), -1, np.int64)
+        """[n_keys, 1 + MAX_DIMS] int32: dtype code + padded shape; all -1 = exhausted.
+        int32 on purpose: the collective downcasts int64 silently, so announce what is
+        actually delivered."""
+        h = np.full((len(self._keys), 1 + self._MAX_DIMS), -1, np.int32)
         if batch is not None:
             for row, key in enumerate(self._keys):
                 value = batch.get(key)
@@ -134,6 +144,23 @@ class DispatchingDataLoader:
                     h[row, 0] = 0
                     continue
                 value = np.asarray(value)
+                if value.ndim > self._MAX_DIMS:
+                    raise ValueError(
+                        f"DispatchingDataLoader cannot broadcast batch key '{key}' with "
+                        f"ndim {value.ndim}; the header carries at most {self._MAX_DIMS} "
+                        "dims"
+                    )
+                if value.dtype == np.int64:
+                    # the collective would silently downcast int64 -> int32 (x64 disabled);
+                    # cast explicitly after proving no value is truncated
+                    info = np.iinfo(np.int32)
+                    if value.size and (value.min() < info.min or value.max() > info.max):
+                        raise ValueError(
+                            f"DispatchingDataLoader batch key '{key}' holds int64 values "
+                            "outside int32 range; broadcast_one_to_all would truncate "
+                            "them silently under x64-disabled JAX"
+                        )
+                    value = value.astype(np.int32)
                 code = next(
                     (
                         i
@@ -193,12 +220,8 @@ class DispatchingDataLoader:
 
     def __len__(self) -> int:
         if self.local_loader is not None:
-            return len(self.local_loader)
-        # a collective here could deadlock against a process that never calls len(), so
-        # receivers learn the true length only with the first epoch's schema broadcast;
-        # before that this is a length HINT (list() etc. call __len__ eagerly) — the train
-        # loops pace by step count, never by loader length
-        return self._length if self._length is not None else 0
+            return len(self.local_loader)  # live: may change after load_state_dict
+        return self._length  # broadcast eagerly in __init__, valid before iteration
 
     def state_dict(self) -> dict:
         # only the reading process has loader state; checkpoint writes happen on process 0

@@ -33,6 +33,7 @@ from .model_wrapper import get_model, log_model
 from .optimization import get_optimizer, get_scheduler
 from .train_utils import (
     get_profiler_context,
+    handle_nonfinite_step,
     make_eval_step,
     make_train_step,
     offload_jit_kwargs as _offload_jit_kwargs,
@@ -42,9 +43,13 @@ from .train_utils import (
 from .utils import (
     ExperimentsTracker,
     ProgressBar,
+    StallWatchdog,
     init_distributed,
+    install_preemption_handler,
     log_rank_0,
+    preemption_requested,
     setup_tf32,
+    uninstall_preemption_handler,
 )
 
 
@@ -101,6 +106,7 @@ def train(
     eval_interval = args.training_parameters.eval_interval
     save_interval = args.save_args.save_interval
     log_interval = args.logging_args.log_interval
+    ft_args = args.fault_tolerance_args
 
     def loss_fn(params, micro_batch, rng, fp8_state=None):
         rngs = None if rng is None else {"dropout": rng, "neft": rng}
@@ -115,6 +121,7 @@ def train(
             gradient_accumulation_steps=gradient_accumulation_steps,
             gradient_clipping=args.training_parameters.gradient_clipping,
             offload_optimizer=offload,
+            skip_nonfinite=ft_args.skip_nonfinite_steps,
         ),
         donate_argnums=(0,),
         **jit_kwargs,
@@ -135,6 +142,14 @@ def train(
 
     micro_batches_per_step = gradient_accumulation_steps
     batch_iter = infinite_iterator(train_dataloader)
+    if ft_args.dataloader_stall_timeout_seconds is not None:
+        batch_iter = StallWatchdog(
+            batch_iter,
+            ft_args.dataloader_stall_timeout_seconds,
+            description="train dataloader",
+        )
+    if ft_args.preemption_checkpointing:
+        install_preemption_handler()
 
     # running mean folds EVERY step (reference `train_utils.py:130-141`): accumulate the
     # device scalar asynchronously, sync to host only at log time
@@ -143,55 +158,100 @@ def train(
     progress = ProgressBar(starting_iteration, num_training_steps)
 
     global_step = starting_iteration
-    while global_step < num_training_steps:
-        global_step += 1
-        step_start = time.perf_counter()
+    last_saved_step = None
+    consecutive_nonfinite = 0
+    preempted = False
+    try:
+        while global_step < num_training_steps:
+            global_step += 1
+            step_start = time.perf_counter()
 
-        micro_batches = [next(batch_iter) for _ in range(micro_batches_per_step)]
-        batch = _stack_micro_batches(micro_batches)
+            micro_batches = [next(batch_iter) for _ in range(micro_batches_per_step)]
+            batch = _stack_micro_batches(micro_batches)
 
-        jax_rng, step_rng = jax.random.split(jax_rng)
-        with get_profiler_context(
-            args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
-        ):
-            state, metrics = train_step(state, batch, step_rng)
+            jax_rng, step_rng = jax.random.split(jax_rng)
+            with get_profiler_context(
+                args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
+            ):
+                state, metrics = train_step(state, batch, step_rng)
 
-        loss_running_sum = loss_running_sum + metrics["loss"]
-        loss_running_count += 1
+            step_skipped = False
+            if ft_args.skip_nonfinite_steps:
+                # host sync per step — the price of counting consecutive skips promptly
+                step_skipped = bool(metrics["skipped"])
+                consecutive_nonfinite = handle_nonfinite_step(
+                    step_skipped,
+                    consecutive_nonfinite,
+                    global_step,
+                    ft_args.max_consecutive_nonfinite_steps,
+                )
 
-        if global_step % log_interval == 0:
-            loss = float(metrics["loss"])
-            track_train_metrics(
-                global_step=global_step,
-                train_loss_step=loss,
-                grad_norm=float(metrics["grad_norm"]),
-                current_lr=float(lr_schedule(global_step)),
-                experiments_tracker=experiments_tracker,
-                loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
-                step_time=time.perf_counter() - step_start,
-            )
+            if not step_skipped:  # a skipped step's loss is non-finite; keep the mean clean
+                loss_running_sum = loss_running_sum + metrics["loss"]
+                loss_running_count += 1
 
-        progress.track(global_step)
+            if global_step % log_interval == 0:
+                loss = float(metrics["loss"])
+                track_train_metrics(
+                    global_step=global_step,
+                    train_loss_step=loss,
+                    grad_norm=float(metrics["grad_norm"]),
+                    current_lr=float(lr_schedule(global_step)),
+                    experiments_tracker=experiments_tracker,
+                    loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
+                    step_time=time.perf_counter() - step_start,
+                )
 
-        if eval_during_training and eval_interval and global_step % eval_interval == 0:
-            evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
+            progress.track(global_step)
 
-        if global_step % save_interval == 0 or global_step == num_training_steps:
-            save_checkpoint(
-                args,
-                model,
-                state,
-                train_dataloader,
-                experiments_tracker,
-                global_step,
-                jax_rng=jax_rng,
-            )
+            if eval_during_training and eval_interval and global_step % eval_interval == 0:
+                evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
 
-    finish_pending_checkpoint()  # commit an in-flight async save before exiting
+            if global_step % save_interval == 0 or global_step == num_training_steps:
+                save_checkpoint(
+                    args,
+                    model,
+                    state,
+                    train_dataloader,
+                    experiments_tracker,
+                    global_step,
+                    jax_rng=jax_rng,
+                )
+                last_saved_step = global_step
+
+            if preemption_requested():
+                preempted = True
+                log_rank_0(
+                    logging.WARNING,
+                    f"preemption notice: saving final checkpoint at step {global_step} "
+                    "and exiting",
+                )
+                if last_saved_step != global_step:
+                    save_checkpoint(
+                        args,
+                        model,
+                        state,
+                        train_dataloader,
+                        experiments_tracker,
+                        global_step,
+                        jax_rng=jax_rng,
+                    )
+                break
+
+        finish_pending_checkpoint()  # commit an in-flight async save before exiting
+    finally:
+        if ft_args.preemption_checkpointing:
+            uninstall_preemption_handler()
+        if isinstance(batch_iter, StallWatchdog):
+            batch_iter.close()
 
     # final eval only when the loop didn't just run one at this step (reference finetune.py
-    # evaluates only in-loop)
-    if eval_during_training and (not eval_interval or global_step % eval_interval != 0):
+    # evaluates only in-loop); a preempted run skips it — the grace window is for saving
+    if (
+        not preempted
+        and eval_during_training
+        and (not eval_interval or global_step % eval_interval != 0)
+    ):
         evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
 
 

@@ -38,6 +38,7 @@ from .model_wrapper import get_model, log_model
 from .train_utils import (
     get_model_tflops,
     get_profiler_context,
+    handle_nonfinite_step,
     make_eval_step,
     make_train_step,
     offload_jit_kwargs as _offload_jit_kwargs,
@@ -47,9 +48,13 @@ from .train_utils import (
 from .utils import (
     ExperimentsTracker,
     ProgressBar,
+    StallWatchdog,
     init_distributed,
+    install_preemption_handler,
     log_rank_0,
+    preemption_requested,
     setup_tf32,
+    uninstall_preemption_handler,
 )
 
 
@@ -149,6 +154,7 @@ def train(
     eval_steps = args.datasets[0].class_args.get("eval_steps", 0) or 0
     save_interval = args.save_args.save_interval
     log_interval = args.logging_args.log_interval
+    ft_args = args.fault_tolerance_args
 
     dp_world_size = get_data_parallel_world_size(args)
     samples_per_step = micro_batch_size * gradient_accumulation_steps * dp_world_size
@@ -179,6 +185,7 @@ def train(
             gradient_accumulation_steps=gradient_accumulation_steps,
             gradient_clipping=args.training_parameters.gradient_clipping,
             offload_optimizer=offload,
+            skip_nonfinite=ft_args.skip_nonfinite_steps,
         ),
         donate_argnums=(0,),
         **jit_kwargs,
@@ -208,6 +215,16 @@ def train(
             group_names=val_group_names,
         )
 
+    batch_iter = train_dataloader
+    if ft_args.dataloader_stall_timeout_seconds is not None:
+        batch_iter = StallWatchdog(
+            batch_iter,
+            ft_args.dataloader_stall_timeout_seconds,
+            description="megatron train dataloader",
+        )
+    if ft_args.preemption_checkpointing:
+        install_preemption_handler()
+
     # running mean folds EVERY step (reference `train_utils.py:130-141`): accumulate the
     # device scalar asynchronously, sync to host only at log time
     loss_running_sum = jnp.zeros((), jnp.float32)
@@ -215,74 +232,118 @@ def train(
     progress = ProgressBar(starting_iteration, num_training_steps)
 
     global_step = starting_iteration
-    while global_step < num_training_steps:
-        global_step += 1
-        step_start = time.perf_counter()
+    last_saved_step = None
+    consecutive_nonfinite = 0
+    preempted = False
+    try:
+        while global_step < num_training_steps:
+            global_step += 1
+            step_start = time.perf_counter()
 
-        micros = [next(train_dataloader) for _ in range(gradient_accumulation_steps)]
-        batch = {"text": jnp.stack([m["text"] for m in micros])}
+            micros = [next(batch_iter) for _ in range(gradient_accumulation_steps)]
+            batch = {"text": jnp.stack([m["text"] for m in micros])}
 
-        jax_rng, step_rng = jax.random.split(jax_rng)
-        with get_profiler_context(
-            args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
-        ):
-            state, metrics = train_step(state, batch, step_rng)
+            jax_rng, step_rng = jax.random.split(jax_rng)
+            with get_profiler_context(
+                args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
+            ):
+                state, metrics = train_step(state, batch, step_rng)
 
-        consumed_samples += samples_per_step
-        loss_running_sum = loss_running_sum + metrics["loss"]
-        loss_running_count += 1
+            consumed_samples += samples_per_step
 
-        if global_step % log_interval == 0:
-            loss = float(metrics["loss"])
-            step_time = time.perf_counter() - step_start
-            track_train_metrics(
-                global_step=global_step,
-                train_loss_step=loss,
-                grad_norm=float(metrics["grad_norm"]),
-                current_lr=float(lr_schedule(global_step)),
-                experiments_tracker=experiments_tracker,
-                loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
-                flops=step_tflops / step_time,
-                billion_tokens_per_day=tokens_per_step * 86400 / step_time / 1e9,
-                step_time=step_time,
-            )
+            step_skipped = False
+            if ft_args.skip_nonfinite_steps:
+                # host sync per step — the price of counting consecutive skips promptly
+                step_skipped = bool(metrics["skipped"])
+                consecutive_nonfinite = handle_nonfinite_step(
+                    step_skipped,
+                    consecutive_nonfinite,
+                    global_step,
+                    ft_args.max_consecutive_nonfinite_steps,
+                )
 
-        progress.track(global_step)
+            if not step_skipped:  # a skipped step's loss is non-finite; keep the mean clean
+                loss_running_sum = loss_running_sum + metrics["loss"]
+                loss_running_count += 1
 
-        if (
-            eval_during_training
-            and eval_interval
-            and eval_steps
-            and global_step % eval_interval == 0
-        ):
-            evaluate(
-                val_dataloaders,
-                model,
-                state,
-                global_step,
-                experiments_tracker,
-                eval_steps,
-                eval_step_fn,
-                group_names=val_group_names,
-            )
+            if global_step % log_interval == 0:
+                loss = float(metrics["loss"])
+                step_time = time.perf_counter() - step_start
+                track_train_metrics(
+                    global_step=global_step,
+                    train_loss_step=loss,
+                    grad_norm=float(metrics["grad_norm"]),
+                    current_lr=float(lr_schedule(global_step)),
+                    experiments_tracker=experiments_tracker,
+                    loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
+                    flops=step_tflops / step_time,
+                    billion_tokens_per_day=tokens_per_step * 86400 / step_time / 1e9,
+                    step_time=step_time,
+                )
 
-        if global_step % save_interval == 0 or global_step == num_training_steps:
-            save_checkpoint(
-                args,
-                model,
-                state,
-                None,  # megatron loaders resume via consumed_samples metadata
-                experiments_tracker,
-                global_step,
-                jax_rng=jax_rng,
-                metadata={"consumed_samples": consumed_samples},
-            )
+            progress.track(global_step)
 
-    finish_pending_checkpoint()  # commit an in-flight async save before exiting
+            if (
+                eval_during_training
+                and eval_interval
+                and eval_steps
+                and global_step % eval_interval == 0
+            ):
+                evaluate(
+                    val_dataloaders,
+                    model,
+                    state,
+                    global_step,
+                    experiments_tracker,
+                    eval_steps,
+                    eval_step_fn,
+                    group_names=val_group_names,
+                )
+
+            if global_step % save_interval == 0 or global_step == num_training_steps:
+                save_checkpoint(
+                    args,
+                    model,
+                    state,
+                    None,  # megatron loaders resume via consumed_samples metadata
+                    experiments_tracker,
+                    global_step,
+                    jax_rng=jax_rng,
+                    metadata={"consumed_samples": consumed_samples},
+                )
+                last_saved_step = global_step
+
+            if preemption_requested():
+                preempted = True
+                log_rank_0(
+                    logging.WARNING,
+                    f"preemption notice: saving final checkpoint at step {global_step} "
+                    "and exiting",
+                )
+                if last_saved_step != global_step:
+                    save_checkpoint(
+                        args,
+                        model,
+                        state,
+                        None,
+                        experiments_tracker,
+                        global_step,
+                        jax_rng=jax_rng,
+                        metadata={"consumed_samples": consumed_samples},
+                    )
+                break
+
+        finish_pending_checkpoint()  # commit an in-flight async save before exiting
+    finally:
+        if ft_args.preemption_checkpointing:
+            uninstall_preemption_handler()
+        if isinstance(batch_iter, StallWatchdog):
+            batch_iter.close()
 
     # final test-set evaluation (reference `pretrain.py:216` evaluates test loaders after
-    # training; val was already evaluated in-loop at this step when the interval divides)
-    if eval_during_training and eval_steps:
+    # training; val was already evaluated in-loop at this step when the interval divides);
+    # a preempted run skips it — the grace window is for saving
+    if not preempted and eval_during_training and eval_steps:
         test_loss = evaluate(
             test_dataloaders,
             model,

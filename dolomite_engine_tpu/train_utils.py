@@ -80,6 +80,7 @@ def make_train_step(
     gradient_clipping: float | None = 1.0,
     rng_per_step: bool = True,
     offload_optimizer: bool = False,
+    skip_nonfinite: bool = False,
 ):
     """Build the jitted train step.
 
@@ -89,6 +90,12 @@ def make_train_step(
     `offload_optimizer` (cpu_offload, TPU only): the incoming opt state lives in pinned host
     memory — stream it to device for the update; the caller's jit `out_shardings` (the state
     shardings from `create_sharded_train_state`) pin the new opt state back to host.
+
+    `skip_nonfinite` (FaultToleranceArgs.skip_nonfinite_steps): when the loss or the global
+    grad-norm is non-finite, a `lax.cond` returns params/opt-state/fp8 UNCHANGED instead of
+    poisoning them with NaN updates; `metrics["skipped"]` reports it (0/1) so the loop can
+    count consecutive skips and abort past a threshold. `step` still advances — a skipped
+    step consumes its batch and keeps host/device step counters aligned.
     """
 
     def train_step(state: TrainState, batch, rng: jax.Array):
@@ -140,16 +147,63 @@ def make_train_step(
             )
 
         grads, grad_norm = clip_grad_norm(grads, gradient_clipping)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+
+        def apply_update(operand):
+            grads, opt_state, params, old_fp8, stepped_fp8 = operand
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state, stepped_fp8
+
+        operand = (grads, state.opt_state, state.params, state.fp8, new_fp8)
+        if skip_nonfinite:
+            step_ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            new_params, new_opt_state, new_fp8 = jax.lax.cond(
+                step_ok,
+                apply_update,
+                # identity: params/opt-state flow through untouched and fp8 reverts to its
+                # PRE-step scaling state (the stepped one saw the non-finite amax)
+                lambda operand: (operand[2], operand[1], operand[3]),
+                operand,
+            )
+        else:
+            step_ok = None
+            new_params, new_opt_state, new_fp8 = apply_update(operand)
 
         new_state = TrainState(
             step=state.step + 1, params=new_params, opt_state=new_opt_state, fp8=new_fp8
         )
         metrics = {"loss": loss, "grad_norm": grad_norm}
+        if step_ok is not None:
+            metrics["skipped"] = (~step_ok).astype(jnp.int32)
         return new_state, metrics
 
     return train_step
+
+
+def handle_nonfinite_step(
+    skipped: bool, consecutive: int, global_step: int, max_consecutive: int
+) -> int:
+    """Host-side consecutive-skip accounting shared by the pretrain/finetune loops.
+
+    Returns the updated consecutive-skip count; raises RuntimeError once `max_consecutive`
+    non-finite steps occur back to back (true divergence or a poisoned data shard — more
+    skipping only burns accelerator time)."""
+    if not skipped:
+        return 0
+    consecutive += 1
+    log_rank_0(
+        logging.WARNING,
+        f"non-finite loss/grad-norm at step {global_step}: optimizer update skipped "
+        f"({consecutive} consecutive, abort at {max_consecutive})",
+    )
+    if consecutive >= max_consecutive:
+        raise RuntimeError(
+            f"aborting: {consecutive} consecutive non-finite training steps (threshold "
+            f"fault_tolerance_args.max_consecutive_nonfinite_steps={max_consecutive}) — "
+            "loss has diverged or a data shard is poisoned; resume from the last "
+            "checkpoint with a lower LR or different data skip"
+        )
+    return consecutive
 
 
 def run_timed_windows(

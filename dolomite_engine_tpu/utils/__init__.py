@@ -5,6 +5,14 @@ import os
 
 import jax
 
+from .fault_tolerance import (
+    StallWatchdog,
+    install_preemption_handler,
+    preemption_requested,
+    request_preemption,
+    reset_preemption,
+    uninstall_preemption_handler,
+)
 from .logger import (
     get_logger,
     log_rank_0,
@@ -23,6 +31,7 @@ from .packages import (
     is_wandb_available,
 )
 from .pydantic import BaseArgs
+from .retry import TRANSIENT_IO_ERRORS, retry_io
 from .safetensors import SafeTensorsWeightsManager
 from .tracking import ExperimentsTracker, ProgressBar
 from .yaml import dump_yaml, load_yaml

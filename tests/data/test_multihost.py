@@ -178,12 +178,14 @@ def test_dispatching_loader_receiver_lockstep(monkeypatch):
     MeshManager(data_parallel_sharding_world_size=4)
     try:
         mesh = MeshManager.get_mesh()
-        source = dl.DispatchingDataLoader(_FakeLoader(), mesh)
 
+        # record from BEFORE construction: __init__ now broadcasts the loader length
+        # eagerly, and the receiver must replay that collective too
         channel = []
         monkeypatch.setattr(
             dl.DispatchingDataLoader, "_broadcast", staticmethod(lambda t: (channel.append(t), t)[1])
         )
+        source = dl.DispatchingDataLoader(_FakeLoader(), mesh)
 
         src_batches = list(source)
 
@@ -194,6 +196,8 @@ def test_dispatching_loader_receiver_lockstep(monkeypatch):
             dl.DispatchingDataLoader, "_broadcast", staticmethod(lambda t: next(replay))
         )
         receiver = dl.DispatchingDataLoader(None, mesh)
+        # the eager length broadcast makes len() correct BEFORE the first batch
+        assert len(receiver) == 3
         rec_batches = list(receiver)
         assert len(receiver) == 3
 
@@ -222,6 +226,57 @@ def test_dispatching_loader_rejects_unsupported_dtype():
     try:
         loader = DispatchingDataLoader(_BadLoader(), MeshManager.get_mesh())
         with pytest.raises(ValueError, match="weights.*float64"):
+            next(iter(loader))
+    finally:
+        MeshManager.destroy()
+
+
+def test_dispatching_loader_int64_cast_and_overflow(monkeypatch):
+    """int64 batches: broadcast_one_to_all silently downcasts int64->int32 under default
+    x64-disabled JAX (ADVICE.md #1), so the sender casts explicitly after a range check —
+    in-range values arrive as int32 bit-equal, out-of-range values fail loudly."""
+    from dolomite_engine_tpu.data.dataloader import DispatchingDataLoader
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    class _Int64Loader(_FakeLoader):
+        def __iter__(self):
+            yield {"ids": np.arange(48, dtype=np.int64).reshape(8, 6)}
+
+    class _OverflowLoader(_FakeLoader):
+        def __iter__(self):
+            yield {"ids": np.full((8, 6), 2**40, np.int64)}
+
+    MeshManager.destroy()
+    MeshManager(data_parallel_sharding_world_size=4)
+    try:
+        mesh = MeshManager.get_mesh()
+        batch = next(iter(DispatchingDataLoader(_Int64Loader(), mesh)))
+        assert np.asarray(batch["ids"]).dtype == np.int32
+        np.testing.assert_array_equal(
+            np.asarray(batch["ids"]), np.arange(48).reshape(8, 6)
+        )
+
+        with pytest.raises(ValueError, match="ids.*int32 range"):
+            next(iter(DispatchingDataLoader(_OverflowLoader(), mesh)))
+    finally:
+        MeshManager.destroy()
+
+
+def test_dispatching_loader_rejects_excess_dims():
+    """A batch array with more dims than the fixed-size header carries must raise a clear
+    ValueError naming key and ndim, not an opaque numpy broadcast error (ADVICE.md #3)."""
+    from dolomite_engine_tpu.data.dataloader import DispatchingDataLoader
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    class _DeepLoader(_FakeLoader):
+        def __iter__(self):
+            yield {"deep": np.ones((4, 1, 1, 1, 1, 1, 2), np.int32)}
+
+    MeshManager.destroy()
+    MeshManager(data_parallel_sharding_world_size=4)
+    try:
+        loader = DispatchingDataLoader(_DeepLoader(), MeshManager.get_mesh())
+        with pytest.raises(ValueError, match="deep.*ndim 7"):
             next(iter(loader))
     finally:
         MeshManager.destroy()

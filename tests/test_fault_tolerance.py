@@ -1,0 +1,477 @@
+"""Fault-injection tests for the fault-tolerance layer (ISSUE 1 tentpole).
+
+Covers: preemption-triggered final checkpoint + resume, the async-save crash window
+(`latest` never names a torn checkpoint), retry-with-backoff on transient I/O errors,
+non-finite-step skipping (params bit-identical) and the consecutive-skip abort,
+`keep_last_n` retention (never deleting the `latest`-pointed checkpoint), and the
+dataloader stall watchdog.
+
+These drive the REAL `finetune.train` loop with a minimal pure-pytree "model" — the
+checkpoint/loop wiring under test is identical to production; only the network forward is
+simplified (and independent of the sharded model-construction path)."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dolomite_engine_tpu import checkpointing, finetune
+from dolomite_engine_tpu.arguments import TrainingArgs
+from dolomite_engine_tpu.checkpointing import (
+    _commit_checkpoint,
+    _prune_old_checkpoints,
+    finish_pending_checkpoint,
+    load_checkpoint_for_training,
+    save_checkpoint,
+)
+from dolomite_engine_tpu.train_utils import TrainState, make_train_step
+from dolomite_engine_tpu.utils import (
+    StallWatchdog,
+    install_preemption_handler,
+    preemption_requested,
+    request_preemption,
+    reset_preemption,
+    retry_io,
+    uninstall_preemption_handler,
+)
+from dolomite_engine_tpu.utils.fault_tolerance import _PREVIOUS_HANDLERS
+
+
+# --------------------------------------------------------------------------- harness
+
+
+class _Model:
+    """Pure-pytree stand-in: loss = mean(w * x) + 0*b. Exercises value_and_grad, the
+    optimizer update, and every checkpoint path without building a sharded model."""
+
+    def loss(self, params, batch, rngs=None, train=True, fp8_state=None):
+        return jnp.mean(params["w"] * batch["x"]) + jnp.sum(params["b"]) * 0.0
+
+
+class _Loader:
+    """Finite epoch the loop wraps in infinite_iterator; optionally yields NaN batches on
+    chosen global micro-step indices (fault injection at the data level)."""
+
+    def __init__(self, nan_steps=(), n=4):
+        self.nan_steps = set(nan_steps)
+        self.n = n
+        self.count = 0
+
+    def __iter__(self):
+        for _ in range(self.n):
+            value = np.nan if self.count in self.nan_steps else 1.0
+            self.count += 1
+            yield {"x": np.full((2, 4), value, np.float32)}
+
+    def state_dict(self):
+        return {"count": self.count}
+
+    def load_state_dict(self, sd):
+        self.count = sd["count"]
+
+
+def _args(tmp_path, num_steps=5, load_path=None, save_interval=100, **ft_kwargs):
+    cfg = dict(
+        model_args=dict(
+            model_class="AutoModelForCausalLM",
+            pretrained_config=dict(model_type="gpt_dolomite", vocab_size=8, n_positions=8,
+                                   n_embd=4, n_layer=1, n_head=1),
+        ),
+        tuning_args=dict(tuning_method="full_finetuning"),
+        training_parameters=dict(
+            num_training_steps=num_steps,
+            micro_batch_size=2,
+            gradient_accumulation_steps=1,
+            eval_during_training=False,
+        ),
+        datasets=[dict(class_name="DebugDataset", data_name="debug", class_args={})],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=save_interval),
+        random_args=dict(seed=3),
+    )
+    if ft_kwargs:
+        cfg["fault_tolerance_args"] = ft_kwargs
+    if load_path is not None:
+        cfg["load_args"] = dict(load_path=load_path)
+    return TrainingArgs(**cfg)
+
+
+def _fresh_state():
+    params = {"w": jnp.ones((4,), jnp.float32), "b": jnp.zeros((2,), jnp.float32)}
+    optimizer = optax.adam(1e-2)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
+    )
+    return state, optimizer
+
+
+def _run_train(args, loader, monkeypatch=None, preempt_at=None, state=None):
+    if state is None:
+        state, optimizer = _fresh_state()
+    else:
+        _, optimizer = _fresh_state()
+    if preempt_at is not None:
+        from dolomite_engine_tpu.train_utils import track_train_metrics as real_track
+
+        def tracked(**kwargs):
+            real_track(**kwargs)
+            if kwargs["global_step"] == preempt_at:
+                request_preemption()
+
+        monkeypatch.setattr(finetune, "track_train_metrics", tracked)
+    finetune.train(
+        args,
+        _Model(),
+        state,
+        optimizer,
+        lambda step: 1e-2,
+        loader,
+        None,
+        experiments_tracker=None,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption_state():
+    reset_preemption()
+    yield
+    uninstall_preemption_handler()
+    checkpointing._PENDING = None
+
+
+# --------------------------------------------------------------------------- tentpole e2e
+
+
+def test_preemption_saves_final_checkpoint_and_resumes(tmp_path, monkeypatch):
+    """SIGTERM-style notice mid-run -> final synchronous checkpoint at the interrupted
+    step; a fresh process resumes from it at that step with the saved params."""
+    args = _args(tmp_path, num_steps=9)
+    _run_train(args, _Loader(), monkeypatch, preempt_at=3)
+
+    latest = tmp_path / "ckpt" / "latest_checkpointed_iteration.json"
+    with open(latest) as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 3
+    assert (tmp_path / "ckpt" / "global_step3" / "state").is_dir()
+
+    # resume exactly where the preempted run stopped
+    state, _ = _fresh_state()
+    args2 = _args(tmp_path, num_steps=9, load_path=str(tmp_path / "ckpt"))
+    restored, start, _, _ = load_checkpoint_for_training(args2, state)
+    assert start == 3
+    assert int(restored.step) == 3
+    # three adam steps moved w away from init
+    assert not np.allclose(np.asarray(restored.params["w"]), 1.0)
+
+
+def test_preemption_does_not_double_save(tmp_path, monkeypatch):
+    """Preemption right after a periodic save at the same step must not save twice (the
+    second save would only widen the crash window)."""
+    calls = []
+    real_save = finetune.save_checkpoint
+
+    def counting_save(*a, **k):
+        calls.append(a[5])  # iteration
+        return real_save(*a, **k)
+
+    monkeypatch.setattr(finetune, "save_checkpoint", counting_save)
+    args = _args(tmp_path, num_steps=9, save_interval=3)
+    _run_train(args, _Loader(), monkeypatch, preempt_at=3)
+    assert calls == [3]
+
+
+def test_signal_handler_sets_flag_and_restores():
+    install_preemption_handler()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 2
+        while not preemption_requested() and time.time() < deadline:
+            time.sleep(0.01)
+        assert preemption_requested()
+    finally:
+        uninstall_preemption_handler()
+    assert not preemption_requested()
+    assert not _PREVIOUS_HANDLERS
+
+
+def test_second_sigint_raises_keyboard_interrupt():
+    install_preemption_handler()
+    try:
+        os.kill(os.getpid(), signal.SIGINT)
+        deadline = time.time() + 2
+        while not preemption_requested() and time.time() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+            time.sleep(2)  # interrupted by the handler's raise
+    finally:
+        uninstall_preemption_handler()
+
+
+# --------------------------------------------------------------------------- async crash window
+
+
+def test_crash_during_async_save_keeps_last_durable_checkpoint(tmp_path):
+    """Kill between the async write start and its commit: `latest` still names the previous
+    durable checkpoint and resume restores it — the in-flight save is lost, nothing else."""
+    args = _args(tmp_path, num_steps=5)
+    args.save_args.async_checkpointing = True
+    state, _ = _fresh_state()
+
+    save_checkpoint(args, None, state, None, None, iteration=2)
+    finish_pending_checkpoint()  # committed: latest -> 2
+
+    bumped = TrainState(
+        step=state.step + 2, params=state.params, opt_state=state.opt_state
+    )
+    save_checkpoint(args, None, bumped, None, None, iteration=4)
+    # simulate the process dying before finish_pending_checkpoint ever runs
+    checkpointing._get_checkpointer().wait_until_finished()
+    checkpointing._PENDING = None
+
+    with open(tmp_path / "ckpt" / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 2
+
+    fresh, _ = _fresh_state()
+    args2 = _args(tmp_path, load_path=str(tmp_path / "ckpt"))
+    restored, start, _, _ = load_checkpoint_for_training(args2, fresh)
+    assert start == 2 and int(restored.step) == 0  # saved step field was 0 at iteration 2
+
+
+def test_commit_refuses_torn_checkpoint(tmp_path):
+    """The integrity gate: a missing/torn state dir must fail the commit and leave `latest`
+    untouched, instead of advancing the pointer to an unrestorable checkpoint."""
+    args = _args(tmp_path, num_steps=5)
+    state, _ = _fresh_state()
+    save_checkpoint(args, None, state, None, None, iteration=1)  # latest -> 1
+
+    torn = tmp_path / "ckpt" / "global_step2"
+    torn.mkdir()  # state/ never materializes: torn write
+    with pytest.raises(FileNotFoundError, match="torn or incomplete"):
+        _commit_checkpoint(str(tmp_path / "ckpt"), 2, {"attempts": 1}, None)
+    with open(tmp_path / "ckpt" / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 1
+
+
+# --------------------------------------------------------------------------- retry
+
+
+def test_retry_io_recovers_from_transient_oserror():
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient storage blip")
+        return "ok"
+
+    assert (
+        retry_io(flaky, attempts=4, base_delay_seconds=0.5, sleep=sleeps.append) == "ok"
+    )
+    assert len(calls) == 3
+    assert sleeps == [0.5, 1.0]  # exponential backoff
+
+
+def test_retry_io_caps_backoff_and_exhausts():
+    sleeps = []
+
+    def always_fails():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry_io(
+            always_fails,
+            attempts=4,
+            base_delay_seconds=10.0,
+            max_delay_seconds=15.0,
+            sleep=sleeps.append,
+        )
+    assert sleeps == [10.0, 15.0, 15.0]  # capped at max_delay
+
+
+def test_retry_io_does_not_retry_programming_errors():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("tree structure mismatch")
+
+    with pytest.raises(ValueError):
+        retry_io(boom, attempts=5, sleep=lambda d: None)
+    assert len(calls) == 1
+
+
+def test_save_checkpoint_retries_transient_write_error(tmp_path, monkeypatch):
+    """A flaky orbax save succeeds on retry and commits normally."""
+    args = _args(tmp_path, num_steps=5, checkpoint_io_backoff_seconds=0.0)
+    state, _ = _fresh_state()
+    real = checkpointing._get_checkpointer().save
+    failures = []
+
+    def flaky_save(*a, **k):
+        if not failures:
+            failures.append(1)
+            raise OSError("fuse mount hiccup")
+        return real(*a, **k)
+
+    monkeypatch.setattr(checkpointing._get_checkpointer(), "save", flaky_save)
+    save_checkpoint(args, None, state, None, None, iteration=1)
+    with open(tmp_path / "ckpt" / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 1
+
+
+# --------------------------------------------------------------------------- nan guard
+
+
+def test_nonfinite_step_skips_update_and_training_continues(tmp_path, monkeypatch):
+    """One poisoned batch: the update is skipped (params bit-identical across that step),
+    the run completes, and the final checkpoint holds finite params."""
+    args = _args(
+        tmp_path, num_steps=4, save_interval=4, skip_nonfinite_steps=True
+    )
+    _run_train(args, _Loader(nan_steps={1}), monkeypatch)
+
+    fresh, _ = _fresh_state()
+    args2 = _args(tmp_path, load_path=str(tmp_path / "ckpt"))
+    restored, start, _, _ = load_checkpoint_for_training(args2, fresh)
+    assert start == 4
+    assert np.isfinite(np.asarray(restored.params["w"])).all()
+
+
+def test_nonfinite_step_preserves_params_bitwise():
+    state, optimizer = _fresh_state()
+    step = jax.jit(
+        make_train_step(
+            lambda p, micro, rng: jnp.mean(p["w"] * micro["x"]),
+            optimizer,
+            gradient_accumulation_steps=1,
+            gradient_clipping=1.0,
+            skip_nonfinite=True,
+        )
+    )
+    before = np.asarray(state.params["w"]).copy()
+    opt_before = jax.tree.leaves(jax.tree.map(np.asarray, state.opt_state))
+    new_state, metrics = step(
+        state, {"x": jnp.full((1, 2, 4), jnp.inf)}, jax.random.PRNGKey(0)
+    )
+    assert int(metrics["skipped"]) == 1
+    np.testing.assert_array_equal(np.asarray(new_state.params["w"]), before)
+    for a, b in zip(opt_before, jax.tree.leaves(jax.tree.map(np.asarray, new_state.opt_state))):
+        np.testing.assert_array_equal(a, b)
+    # and a finite batch afterwards trains normally
+    new_state, metrics = step(
+        new_state, {"x": jnp.ones((1, 2, 4))}, jax.random.PRNGKey(1)
+    )
+    assert int(metrics["skipped"]) == 0
+    assert not np.array_equal(np.asarray(new_state.params["w"]), before)
+
+
+def test_consecutive_nonfinite_steps_abort(tmp_path, monkeypatch):
+    args = _args(
+        tmp_path,
+        num_steps=20,
+        skip_nonfinite_steps=True,
+        max_consecutive_nonfinite_steps=3,
+    )
+    with pytest.raises(RuntimeError, match="3 consecutive non-finite"):
+        _run_train(args, _Loader(nan_steps=set(range(100)), n=8), monkeypatch)
+
+
+# --------------------------------------------------------------------------- retention
+
+
+def _save_iterations(args, state, iterations):
+    for i in iterations:
+        save_checkpoint(args, None, state, None, None, iteration=i)
+
+
+def test_keep_last_n_prunes_old_checkpoints(tmp_path):
+    args = _args(tmp_path, num_steps=5)
+    args.save_args.keep_last_n = 2
+    state, _ = _fresh_state()
+    _save_iterations(args, state, [1, 2, 3, 4])
+
+    root = tmp_path / "ckpt"
+    kept = sorted(d for d in os.listdir(root) if d.startswith("global_step"))
+    assert kept == ["global_step3", "global_step4"]
+    with open(root / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 4
+
+
+def test_prune_never_deletes_latest_pointed_checkpoint(tmp_path):
+    """After a rollback-resume `latest` may name an OLD iteration; retention must keep it
+    even when it falls outside the newest-N window."""
+    args = _args(tmp_path, num_steps=5)
+    state, _ = _fresh_state()
+    _save_iterations(args, state, [1, 2, 3])
+    root = str(tmp_path / "ckpt")
+    # roll back: latest -> 1
+    checkpointing._write_latest(root, 1)
+
+    _prune_old_checkpoints(root, keep_last_n=1)
+    kept = sorted(d for d in os.listdir(root) if d.startswith("global_step"))
+    assert kept == ["global_step1", "global_step3"]  # newest + latest-pointed
+
+
+def test_keep_last_n_with_async_commits(tmp_path):
+    """Retention runs at COMMIT time for async saves — pruning must not outrun the pointer."""
+    args = _args(tmp_path, num_steps=5)
+    args.save_args.async_checkpointing = True
+    args.save_args.keep_last_n = 1
+    state, _ = _fresh_state()
+    _save_iterations(args, state, [1, 2])
+    finish_pending_checkpoint()
+
+    root = tmp_path / "ckpt"
+    kept = sorted(d for d in os.listdir(root) if d.startswith("global_step"))
+    assert kept == ["global_step2"]
+    with open(root / "latest_checkpointed_iteration.json") as f:
+        assert json.load(f)["latest_checkpointed_iteration"] == 2
+
+
+# --------------------------------------------------------------------------- stall watchdog
+
+
+def test_stall_watchdog_passthrough_and_stop_iteration():
+    w = StallWatchdog(iter([1, 2]), timeout_seconds=5.0)
+    assert list(w) == [1, 2]  # StopIteration propagates through the worker
+    w.close()
+    # None timeout: pure pass-through, no thread
+    w2 = StallWatchdog(iter([3]), timeout_seconds=None)
+    assert next(w2) == 3
+    assert w2._thread is None
+
+
+def test_stall_watchdog_raises_on_hang():
+    release = threading.Event()
+
+    def hung():
+        yield 1
+        release.wait(30)
+        yield 2
+
+    w = StallWatchdog(hung(), timeout_seconds=0.2, description="train dataloader")
+    assert next(w) == 1
+    with pytest.raises(RuntimeError, match="train dataloader stalled"):
+        next(w)
+    release.set()
+    w.close()
+
+
+def test_stall_watchdog_in_train_loop(tmp_path, monkeypatch):
+    """Loop-level wiring: a loader that hangs mid-run aborts with the watchdog's error."""
+
+    class _HangingLoader(_Loader):
+        def __iter__(self):
+            yield {"x": np.ones((2, 4), np.float32)}
+            yield {"x": np.ones((2, 4), np.float32)}
+            time.sleep(30)
+
+    args = _args(tmp_path, num_steps=5, dataloader_stall_timeout_seconds=0.5)
+    with pytest.raises(RuntimeError, match="stalled"):
+        _run_train(args, _HangingLoader(), monkeypatch)

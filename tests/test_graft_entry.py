@@ -29,3 +29,29 @@ def test_dryrun_multichip_subprocess_fallback(monkeypatch):
     # dryrun_multichip must self-provision a virtual CPU mesh in a subprocess.
     monkeypatch.setattr(jax, "devices", lambda: jax.local_devices()[:1])
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_dryrun_sentinel_canary():
+    # The sharding-regression guard is a grep for an XLA warning string; this canary proves
+    # the installed XLA still emits it on a deliberately-broken constraint (ADVICE.md #4) —
+    # a silent rewording would otherwise disable the guard without failing anything.
+    __graft_entry__.dryrun_sharding_canary()
+
+
+def test_dryrun_guard_trips_on_sentinel(monkeypatch):
+    # And the guard side: dryrun_multichip must RAISE when its subprocess output carries
+    # the sentinel (grep wiring, independent of whether XLA currently reproduces it).
+    import subprocess
+
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kwargs):
+        result = real_run(
+            [cmd[0], "-c", f"print('{__graft_entry__._SPMD_REMAT_SENTINEL}')"],
+            **{k: v for k, v in kwargs.items() if k != "timeout"},
+        )
+        return result
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(RuntimeError, match="full rematerialization"):
+        __graft_entry__.dryrun_multichip(8)
