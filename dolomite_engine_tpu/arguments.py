@@ -455,6 +455,38 @@ class WandBArgs(BaseArgs):
         _check_not_None([(self.project, "project"), (self.name, "name")])
 
 
+class TelemetryArgs(BaseArgs):
+    """Always-on structured telemetry (docs/OBSERVABILITY.md): goodput breakdown, MFU,
+    device-memory gauges, and fault-tolerance counters land in a per-host JSONL sink with no
+    optional deps; on-demand profiling captures a labeled N-step trace mid-run."""
+
+    # rank-tagged JSONL metrics sink: per-step timings, per-window goodput breakdown + MFU +
+    # memory gauges, cumulative counters
+    jsonl_sink: bool = True
+    # sink path; None derives <save_args.save_path>/telemetry/rank-<process>.jsonl
+    jsonl_path: str | None = None
+    # poll for a profile trigger each step; touching the trigger file (or SIGUSR1) captures
+    # a labeled trace of the next profile_steps steps without restarting the run
+    on_demand_profiling: bool = False
+    # trigger file polled each step; None derives <save_path>/telemetry/PROFILE_TRIGGER
+    profile_trigger_path: str | None = None
+    # also arm the capture on SIGUSR1 (only installed when on_demand_profiling is on)
+    profile_on_sigusr1: bool = True
+    # train steps covered by each on-demand capture
+    profile_steps: int = 3
+    # trace output dir; None derives <save_path>/telemetry/traces
+    profile_output_path: str | None = None
+    # per-device peak TFLOPs for MFU; None auto-detects from device_kind (TPU v2-v6e table,
+    # utils/telemetry.py), or set DOLOMITE_PEAK_TFLOPS_PER_DEVICE
+    peak_tflops_per_device: float | None = None
+
+    def model_post_init(self, __context: Any) -> None:
+        assert self.profile_steps >= 1, "profile_steps must be >= 1"
+        assert self.peak_tflops_per_device is None or self.peak_tflops_per_device > 0, (
+            "peak_tflops_per_device must be positive or None"
+        )
+
+
 class LoggingArgs(BaseArgs):
     # logging level
     logging_level: str = "INFO"
@@ -471,6 +503,8 @@ class LoggingArgs(BaseArgs):
     # profiler trace path; specifying a path enables jax.profiler traces
     # (reference: torch profiler, `train_utils.py:182-194`)
     torch_profiler_trace_path: str | None = None
+    # always-on telemetry: JSONL metrics sink, goodput/MFU accounting, on-demand profiling
+    telemetry: TelemetryArgs = TelemetryArgs()
 
     def model_post_init(self, __context: Any) -> None:
         if self.experiments_tracker_name == ExperimentsTrackerName.aim:

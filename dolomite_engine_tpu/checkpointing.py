@@ -49,7 +49,7 @@ import orbax.checkpoint as ocp
 from .arguments import InferenceArgs, TrainingArgs, UnshardingArgs, args_from_dict
 from .enums import Mode
 from .train_utils import TrainState
-from .utils import ExperimentsTracker, load_yaml, log_rank_0, retry_io
+from .utils import ExperimentsTracker, get_telemetry, load_yaml, log_rank_0, retry_io, trace_annotation
 
 _TRAINING_CONFIG = "training_config.yml"
 _LATEST = "latest_checkpointed_iteration.json"
@@ -201,6 +201,8 @@ def _commit_checkpoint(
         description=f"write {_LATEST}",
         **retry_kwargs,
     )
+    # counted at commit time (not save start): the durable-checkpoint truth, async included
+    get_telemetry().count("checkpoints_saved")
     _prune_old_checkpoints(save_path, keep_last_n)
 
 
@@ -226,6 +228,7 @@ def _prune_old_checkpoints(save_path: str, keep_last_n: int | None) -> None:
         for iteration in iterations:
             if iteration not in keep:
                 shutil.rmtree(_get_base_path(save_path, iteration), ignore_errors=True)
+                get_telemetry().count("checkpoints_pruned")
                 log_rank_0(
                     logging.INFO,
                     f"pruned checkpoint global_step{iteration} (keep_last_n={keep_last_n})",
@@ -277,17 +280,20 @@ def save_checkpoint(
         to_save = TrainState(step=state.step, params=state.params, opt_state=(), fp8=state.fp8)
 
     checkpointer = _get_checkpointer()
-    retry_io(
-        lambda: checkpointer.save(os.path.abspath(_state_path(base)), to_save, force=True),
-        description=f"start checkpoint save global_step{iteration}",
-        **retry_kwargs,
-    )
-    if not is_async:
+    # labeled scope: in captured traces the checkpoint device->host copy (and the sync wait)
+    # shows up under the same name as the goodput bucket
+    with trace_annotation("checkpoint_save"):
         retry_io(
-            checkpointer.wait_until_finished,
-            description=f"checkpoint write global_step{iteration}",
+            lambda: checkpointer.save(os.path.abspath(_state_path(base)), to_save, force=True),
+            description=f"start checkpoint save global_step{iteration}",
             **retry_kwargs,
         )
+        if not is_async:
+            retry_io(
+                checkpointer.wait_until_finished,
+                description=f"checkpoint write global_step{iteration}",
+                **retry_kwargs,
+            )
 
     rng_path = os.path.join(base, f"rng_state-{jax.process_index()}.json")
     with open(rng_path, "w") as f:

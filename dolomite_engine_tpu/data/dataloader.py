@@ -21,6 +21,8 @@ from typing import Callable, Iterator
 import jax
 import numpy as np
 
+from ..utils.telemetry import get_telemetry, trace_annotation
+
 
 class ResumableDataLoader:
     def __init__(
@@ -207,16 +209,19 @@ class DispatchingDataLoader:
             for row, key in enumerate(self._keys):
                 code = int(header[row, 0])
                 full[key] = None if code <= 0 else np.asarray(next(it))
-            yield {
-                key: (
-                    jax.make_array_from_callback(
-                        value.shape, self.sharding, lambda idx, v=value: v[idx]
+            with trace_annotation("dataloader_assemble"):
+                out = {
+                    key: (
+                        jax.make_array_from_callback(
+                            value.shape, self.sharding, lambda idx, v=value: v[idx]
+                        )
+                        if value is not None
+                        else None
                     )
-                    if value is not None
-                    else None
-                )
-                for key, value in full.items()
-            }
+                    for key, value in full.items()
+                }
+            get_telemetry().count("loader_batches")
+            yield out
 
     def __len__(self) -> int:
         if self.local_loader is not None:
@@ -248,14 +253,17 @@ class ShardedDataLoader:
 
     def __iter__(self) -> Iterator:
         for batch in self.local_loader:
-            yield {
-                k: (
-                    jax.make_array_from_process_local_data(self.sharding, np.asarray(v))
-                    if v is not None
-                    else None
-                )
-                for k, v in batch.items()
-            }
+            with trace_annotation("dataloader_assemble"):
+                out = {
+                    k: (
+                        jax.make_array_from_process_local_data(self.sharding, np.asarray(v))
+                        if v is not None
+                        else None
+                    )
+                    for k, v in batch.items()
+                }
+            get_telemetry().count("loader_batches")
+            yield out
 
     def __len__(self) -> int:
         return len(self.local_loader)

@@ -44,12 +44,17 @@ from .utils import (
     ExperimentsTracker,
     ProgressBar,
     StallWatchdog,
+    build_telemetry,
     init_distributed,
     install_preemption_handler,
+    install_telemetry,
     log_rank_0,
     preemption_requested,
     setup_tf32,
+    step_annotation,
+    trace_annotation,
     uninstall_preemption_handler,
+    uninstall_telemetry,
 )
 
 
@@ -137,8 +142,18 @@ def train(
     if jax_rng is None:
         jax_rng = jax.random.PRNGKey(args.random_args.seed)
 
+    # always-on telemetry (docs/OBSERVABILITY.md): goodput breakdown per logging window into
+    # the per-host JSONL sink, counters from the fault-tolerance/checkpoint layers,
+    # on-demand profiling. No analytic FLOPs model for variable-length finetune batches, so
+    # MFU is omitted here (pretrain reports it).
+    telemetry = build_telemetry(args, experiments_tracker)
+    install_telemetry(telemetry)
+
     if eval_during_training and starting_iteration == 0:
-        evaluate(val_dataloader, model, state, starting_iteration, experiments_tracker, eval_step)
+        with telemetry.timer("eval"), trace_annotation("eval"):
+            evaluate(
+                val_dataloader, model, state, starting_iteration, experiments_tracker, eval_step
+            )
 
     micro_batches_per_step = gradient_accumulation_steps
     batch_iter = infinite_iterator(train_dataloader)
@@ -164,15 +179,19 @@ def train(
     try:
         while global_step < num_training_steps:
             global_step += 1
-            step_start = time.perf_counter()
+            fetch_start = time.perf_counter()
 
-            micro_batches = [next(batch_iter) for _ in range(micro_batches_per_step)]
-            batch = _stack_micro_batches(micro_batches)
+            with trace_annotation("data_fetch"):
+                micro_batches = [next(batch_iter) for _ in range(micro_batches_per_step)]
+                batch = _stack_micro_batches(micro_batches)
+
+            step_start = time.perf_counter()
+            data_seconds = step_start - fetch_start
 
             jax_rng, step_rng = jax.random.split(jax_rng)
             with get_profiler_context(
-                args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
-            ):
+                args.logging_args.torch_profiler_trace_path, global_step
+            ), step_annotation(global_step):
                 state, metrics = train_step(state, batch, step_rng)
 
             step_skipped = False
@@ -190,43 +209,37 @@ def train(
                 loss_running_sum = loss_running_sum + metrics["loss"]
                 loss_running_count += 1
 
-            if global_step % log_interval == 0:
+            logging_step = global_step % log_interval == 0
+            if logging_step:
+                # syncing here puts the outstanding device work in the step bucket below,
+                # so window goodput stays honest without a per-step host sync
                 loss = float(metrics["loss"])
+                grad_norm = float(metrics["grad_norm"])
+            step_seconds = time.perf_counter() - step_start
+            telemetry.record_step(global_step, data_seconds, step_seconds)
+
+            if logging_step:
                 track_train_metrics(
                     global_step=global_step,
                     train_loss_step=loss,
-                    grad_norm=float(metrics["grad_norm"]),
+                    grad_norm=grad_norm,
                     current_lr=float(lr_schedule(global_step)),
                     experiments_tracker=experiments_tracker,
                     loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
-                    step_time=time.perf_counter() - step_start,
+                    step_time=data_seconds + step_seconds,
                 )
+                progress.set_postfix(loss=loss, step_s=data_seconds + step_seconds)
 
             progress.track(global_step)
 
             if eval_during_training and eval_interval and global_step % eval_interval == 0:
-                evaluate(val_dataloader, model, state, global_step, experiments_tracker, eval_step)
+                with telemetry.timer("eval"), trace_annotation("eval"):
+                    evaluate(
+                        val_dataloader, model, state, global_step, experiments_tracker, eval_step
+                    )
 
             if global_step % save_interval == 0 or global_step == num_training_steps:
-                save_checkpoint(
-                    args,
-                    model,
-                    state,
-                    train_dataloader,
-                    experiments_tracker,
-                    global_step,
-                    jax_rng=jax_rng,
-                )
-                last_saved_step = global_step
-
-            if preemption_requested():
-                preempted = True
-                log_rank_0(
-                    logging.WARNING,
-                    f"preemption notice: saving final checkpoint at step {global_step} "
-                    "and exiting",
-                )
-                if last_saved_step != global_step:
+                with telemetry.timer("checkpoint"):
                     save_checkpoint(
                         args,
                         model,
@@ -236,6 +249,32 @@ def train(
                         global_step,
                         jax_rng=jax_rng,
                     )
+                last_saved_step = global_step
+
+            # the window record is emitted after eval/checkpoint so their buckets land in
+            # the window of the step that paid for them
+            if logging_step:
+                telemetry.emit_window(global_step)
+            telemetry.poll_profiler(global_step)
+
+            if preemption_requested():
+                preempted = True
+                log_rank_0(
+                    logging.WARNING,
+                    f"preemption notice: saving final checkpoint at step {global_step} "
+                    "and exiting",
+                )
+                if last_saved_step != global_step:
+                    with telemetry.timer("checkpoint"):
+                        save_checkpoint(
+                            args,
+                            model,
+                            state,
+                            train_dataloader,
+                            experiments_tracker,
+                            global_step,
+                            jax_rng=jax_rng,
+                        )
                 break
 
         finish_pending_checkpoint()  # commit an in-flight async save before exiting
@@ -244,6 +283,8 @@ def train(
             uninstall_preemption_handler()
         if isinstance(batch_iter, StallWatchdog):
             batch_iter.close()
+        telemetry.close()
+        uninstall_telemetry()
 
     # final eval only when the loop didn't just run one at this step (reference finetune.py
     # evaluates only in-loop); a preempted run skips it — the grace window is for saving

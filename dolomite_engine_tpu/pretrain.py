@@ -49,12 +49,17 @@ from .utils import (
     ExperimentsTracker,
     ProgressBar,
     StallWatchdog,
+    build_telemetry,
     init_distributed,
     install_preemption_handler,
+    install_telemetry,
     log_rank_0,
     preemption_requested,
     setup_tf32,
+    step_annotation,
+    trace_annotation,
     uninstall_preemption_handler,
+    uninstall_telemetry,
 )
 
 
@@ -203,17 +208,30 @@ def train(
 
     val_group_names = get_group_names(args, "val_weighted_split_paths")
 
+    # always-on telemetry (docs/OBSERVABILITY.md): goodput breakdown + MFU per logging
+    # window into the per-host JSONL sink, counters from the fault-tolerance/checkpoint
+    # layers, on-demand profiling. MFU needs the per-group analytic FLOPs and how many
+    # devices share one model-parallel group under SPMD.
+    telemetry = build_telemetry(
+        args,
+        experiments_tracker,
+        model_tflops_per_step=step_tflops,
+        devices_per_group=max(jax.device_count() // dp_world_size, 1),
+    )
+    install_telemetry(telemetry)
+
     if eval_during_training and starting_iteration == 0 and eval_steps:
-        evaluate(
-            val_dataloaders,
-            model,
-            state,
-            0,
-            experiments_tracker,
-            eval_steps,
-            eval_step_fn,
-            group_names=val_group_names,
-        )
+        with telemetry.timer("eval"), trace_annotation("eval"):
+            evaluate(
+                val_dataloaders,
+                model,
+                state,
+                0,
+                experiments_tracker,
+                eval_steps,
+                eval_step_fn,
+                group_names=val_group_names,
+            )
 
     batch_iter = train_dataloader
     if ft_args.dataloader_stall_timeout_seconds is not None:
@@ -238,15 +256,19 @@ def train(
     try:
         while global_step < num_training_steps:
             global_step += 1
-            step_start = time.perf_counter()
+            fetch_start = time.perf_counter()
 
-            micros = [next(batch_iter) for _ in range(gradient_accumulation_steps)]
-            batch = {"text": jnp.stack([m["text"] for m in micros])}
+            with trace_annotation("data_fetch"):
+                micros = [next(batch_iter) for _ in range(gradient_accumulation_steps)]
+                batch = {"text": jnp.stack([m["text"] for m in micros])}
+
+            step_start = time.perf_counter()
+            data_seconds = step_start - fetch_start
 
             jax_rng, step_rng = jax.random.split(jax_rng)
             with get_profiler_context(
-                args.logging_args.torch_profiler_trace_path, global_step - starting_iteration
-            ):
+                args.logging_args.torch_profiler_trace_path, global_step
+            ), step_annotation(global_step):
                 state, metrics = train_step(state, batch, step_rng)
 
             consumed_samples += samples_per_step
@@ -266,19 +288,33 @@ def train(
                 loss_running_sum = loss_running_sum + metrics["loss"]
                 loss_running_count += 1
 
-            if global_step % log_interval == 0:
+            logging_step = global_step % log_interval == 0
+            if logging_step:
+                # syncing here puts the outstanding device work in the step bucket below,
+                # so window goodput stays honest without a per-step host sync
                 loss = float(metrics["loss"])
-                step_time = time.perf_counter() - step_start
+                grad_norm = float(metrics["grad_norm"])
+            step_seconds = time.perf_counter() - step_start
+            telemetry.record_step(global_step, data_seconds, step_seconds)
+
+            if logging_step:
+                step_time = data_seconds + step_seconds
                 track_train_metrics(
                     global_step=global_step,
                     train_loss_step=loss,
-                    grad_norm=float(metrics["grad_norm"]),
+                    grad_norm=grad_norm,
                     current_lr=float(lr_schedule(global_step)),
                     experiments_tracker=experiments_tracker,
                     loss_running_mean=float(loss_running_sum) / max(loss_running_count, 1),
                     flops=step_tflops / step_time,
                     billion_tokens_per_day=tokens_per_step * 86400 / step_time / 1e9,
                     step_time=step_time,
+                    mfu=telemetry.current_mfu(),
+                )
+                progress.set_postfix(
+                    loss=loss,
+                    tok_day_B=tokens_per_step * 86400 / step_time / 1e9,
+                    step_s=step_time,
                 )
 
             progress.track(global_step)
@@ -289,29 +325,37 @@ def train(
                 and eval_steps
                 and global_step % eval_interval == 0
             ):
-                evaluate(
-                    val_dataloaders,
-                    model,
-                    state,
-                    global_step,
-                    experiments_tracker,
-                    eval_steps,
-                    eval_step_fn,
-                    group_names=val_group_names,
-                )
+                with telemetry.timer("eval"), trace_annotation("eval"):
+                    evaluate(
+                        val_dataloaders,
+                        model,
+                        state,
+                        global_step,
+                        experiments_tracker,
+                        eval_steps,
+                        eval_step_fn,
+                        group_names=val_group_names,
+                    )
 
             if global_step % save_interval == 0 or global_step == num_training_steps:
-                save_checkpoint(
-                    args,
-                    model,
-                    state,
-                    None,  # megatron loaders resume via consumed_samples metadata
-                    experiments_tracker,
-                    global_step,
-                    jax_rng=jax_rng,
-                    metadata={"consumed_samples": consumed_samples},
-                )
+                with telemetry.timer("checkpoint"):
+                    save_checkpoint(
+                        args,
+                        model,
+                        state,
+                        None,  # megatron loaders resume via consumed_samples metadata
+                        experiments_tracker,
+                        global_step,
+                        jax_rng=jax_rng,
+                        metadata={"consumed_samples": consumed_samples},
+                    )
                 last_saved_step = global_step
+
+            # the window record is emitted after eval/checkpoint so their buckets land in
+            # the window of the step that paid for them
+            if logging_step:
+                telemetry.emit_window(global_step)
+            telemetry.poll_profiler(global_step)
 
             if preemption_requested():
                 preempted = True
@@ -321,16 +365,17 @@ def train(
                     "and exiting",
                 )
                 if last_saved_step != global_step:
-                    save_checkpoint(
-                        args,
-                        model,
-                        state,
-                        None,
-                        experiments_tracker,
-                        global_step,
-                        jax_rng=jax_rng,
-                        metadata={"consumed_samples": consumed_samples},
-                    )
+                    with telemetry.timer("checkpoint"):
+                        save_checkpoint(
+                            args,
+                            model,
+                            state,
+                            None,
+                            experiments_tracker,
+                            global_step,
+                            jax_rng=jax_rng,
+                            metadata={"consumed_samples": consumed_samples},
+                        )
                 break
 
         finish_pending_checkpoint()  # commit an in-flight async save before exiting
@@ -339,6 +384,8 @@ def train(
             uninstall_preemption_handler()
         if isinstance(batch_iter, StallWatchdog):
             batch_iter.close()
+        telemetry.close()
+        uninstall_telemetry()
 
     # final test-set evaluation (reference `pretrain.py:216` evaluates test loaders after
     # training; val was already evaluated in-loop at this step when the interval divides);

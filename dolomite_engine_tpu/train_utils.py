@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import optax
 from flax import struct
 
-from .utils import ExperimentsTracker, log_rank_0
+from .utils import ExperimentsTracker, get_telemetry, log_rank_0
 
 
 class TrainState(struct.PyTreeNode):
@@ -191,6 +191,7 @@ def handle_nonfinite_step(
     if not skipped:
         return 0
     consecutive += 1
+    get_telemetry().count("nan_skips", event=True, step=global_step)
     log_rank_0(
         logging.WARNING,
         f"non-finite loss/grad-norm at step {global_step}: optimizer update skipped "
@@ -255,8 +256,10 @@ def track_train_metrics(
     flops: float | None = None,
     billion_tokens_per_day: float | None = None,
     step_time: float | None = None,
+    mfu: float | None = None,
 ) -> None:
-    """Parity: reference `train_utils.py:119-179` metric names kept identical."""
+    """Parity: reference `train_utils.py:119-179` metric names kept identical; `mfu` (percent
+    of detected per-device peak, utils/telemetry.py) is reported next to the raw FLOPS."""
     metrics = {
         "loss_step": train_loss_step,
         "loss_running_mean": loss_running_mean,
@@ -266,6 +269,8 @@ def track_train_metrics(
         metrics["grad_norm"] = grad_norm
     if flops is not None:
         metrics["FLOPS"] = flops
+    if mfu is not None:
+        metrics["MFU (%)"] = mfu
     if billion_tokens_per_day is not None:
         metrics["throughput (B tokens/day)"] = billion_tokens_per_day
     if step_time is not None:
@@ -280,12 +285,41 @@ def track_train_metrics(
     log_rank_0(logging.INFO, message)
 
 
-def get_profiler_context(trace_path: str | None, step: int, wait: int = 5, active: int = 1):
-    """jax.profiler trace for steps [wait, wait+active) (reference torch-profiler schedule
-    `train_utils.py:182-194`: wait 5, warmup 5, active 1)."""
-    if trace_path is None:
+# set once the fixed-schedule trace window has been captured (or skipped past) this process
+_PROFILER_SCHEDULE_DONE = False
+
+
+def reset_profiler_schedule() -> None:
+    """Re-arm the fixed-schedule profiler (tests; back-to-back train() calls in one process)."""
+    global _PROFILER_SCHEDULE_DONE
+    _PROFILER_SCHEDULE_DONE = False
+
+
+def get_profiler_context(
+    trace_path: str | None, global_step: int, wait: int = 5, active: int = 1
+):
+    """jax.profiler trace of ABSOLUTE global steps (wait, wait + active], one-shot per run.
+
+    The reference torch-profiler schedule (`train_utils.py:182-194`) is wait 5 / warmup 5 /
+    active 1; XLA has no warmup notion (the first step compiled already), so the explicit
+    schedule here is: skip the first `wait` global steps (compile + cache warmup), then trace
+    the next `active` steps. Absolute steps mean a RESUMED run past the window never
+    re-captures (the old relative-step schedule re-traced on every resume); the one-shot
+    latch makes that guarantee explicit even if the caller's step accounting moves backwards.
+
+    On-demand mid-run captures are the telemetry layer's job
+    (`logging_args.telemetry.on_demand_profiling`, utils/telemetry.py) — this context only
+    serves the fixed start-of-run schedule.
+    """
+    global _PROFILER_SCHEDULE_DONE
+    if trace_path is None or _PROFILER_SCHEDULE_DONE:
         return nullcontext()
-    if wait <= step < wait + active and jax.process_index() == 0:
+    if global_step > wait + active:  # resumed past the window: never capture this run
+        _PROFILER_SCHEDULE_DONE = True
+        return nullcontext()
+    if wait < global_step <= wait + active and jax.process_index() == 0:
+        if global_step == wait + active:
+            _PROFILER_SCHEDULE_DONE = True  # window fully traced: one-shot per run
         return jax.profiler.trace(trace_path)
     return nullcontext()
 

@@ -33,6 +33,18 @@ from .packages import (
 from .pydantic import BaseArgs
 from .retry import TRANSIENT_IO_ERRORS, retry_io
 from .safetensors import SafeTensorsWeightsManager
+from .telemetry import (
+    OnDemandProfiler,
+    Telemetry,
+    build_telemetry,
+    collect_memory_gauges,
+    detect_peak_tflops_per_device,
+    get_telemetry,
+    install_telemetry,
+    step_annotation,
+    trace_annotation,
+    uninstall_telemetry,
+)
 from .tracking import ExperimentsTracker, ProgressBar
 from .yaml import dump_yaml, load_yaml
 

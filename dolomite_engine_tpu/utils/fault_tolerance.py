@@ -25,6 +25,7 @@ import threading
 from typing import Any, Iterator
 
 from .logger import log_rank_0
+from .telemetry import get_telemetry
 
 _PREEMPTION = threading.Event()
 _SIGNAL_COUNTS: dict[int, int] = {}
@@ -40,6 +41,8 @@ def _handle_signal(signum: int, frame) -> None:
         raise KeyboardInterrupt
     if not _PREEMPTION.is_set():
         _PREEMPTION.set()
+        # the process is going away — write the event record now, not at the next window
+        get_telemetry().count("preemptions", event=True)
         log_rank_0(
             logging.WARNING,
             f"received signal {signal.Signals(signum).name}: finishing the current step, "
@@ -143,6 +146,8 @@ class StallWatchdog:
         try:
             kind, payload = self._response.get(timeout=self.timeout_seconds)
         except queue.Empty:
+            # the raise below usually kills the run — record the stall durably first
+            get_telemetry().count("loader_stalls", event=True)
             raise RuntimeError(
                 f"{self.description} stalled: no batch within {self.timeout_seconds:.1f}s "
                 "wall-clock — hung storage mount or dead data worker; aborting so the run "

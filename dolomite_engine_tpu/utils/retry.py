@@ -19,6 +19,7 @@ import time
 from typing import Callable, TypeVar
 
 from .logger import log_rank_0
+from .telemetry import get_telemetry
 
 T = TypeVar("T")
 
@@ -51,7 +52,10 @@ def retry_io(
                     logging.ERROR,
                     f"{what} failed after {attempts} attempt(s): {error!r}",
                 )
+                # exhausted retries usually crash the run next — make the record durable
+                get_telemetry().count("io_failures", event=True)
                 raise
+            get_telemetry().count("io_retries")
             delay = min(base_delay_seconds * (2**attempt), max_delay_seconds)
             log_rank_0(
                 logging.WARNING,

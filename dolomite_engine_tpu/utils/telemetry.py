@@ -1,0 +1,575 @@
+"""Always-on telemetry: goodput accounting, rank-tagged JSONL sink, on-demand profiling.
+
+The reference engine's observability stops at rank-0 aim/wandb scalars
+(`dolomite_engine/utils/tracking.py`); without a tracker installed a pod run is a black box.
+This module is the always-on layer underneath: every host appends line-JSON records to a
+local sink (zero optional deps), rank-0 scalars additionally fan out to the existing
+:class:`~dolomite_engine_tpu.utils.tracking.ExperimentsTracker`, and the train loops feed a
+**goodput breakdown** — first-step compile, dataloader wait, jitted step, checkpoint-blocking,
+eval — from which steady-state MFU (vs detected per-device peak FLOPs) and goodput %% are
+derived per logging window.
+
+Sink schema (one JSON object per line; see docs/OBSERVABILITY.md):
+
+    {"kind": "run_start", "ts", "rank", "devices", "device_kind",
+     "peak_tflops_per_device", "model_tflops_per_step", "schema": 1}
+    {"kind": "step",   "ts", "rank", "step", "t": {"data", "step" | "compile"}}
+    {"kind": "window", "ts", "rank", "step", "window_seconds",
+     "goodput": {"compile","data","step","checkpoint","eval","other","goodput_pct"},
+     "step_time": {"count","mean","min","max"}, "mfu_pct", "tflops_per_group",
+     "counters": {...cumulative...}, "gauges": {...device memory, host rss...}}
+    {"kind": "event",  "ts", "rank", "event", "step", ...}   # nan_skip, loader_stall, ...
+    {"kind": "run_end","ts", "rank", "step", "counters"}
+
+Cross-module counters (`utils/retry.py`, `utils/fault_tolerance.py`, `checkpointing.py`,
+`data/dataloader.py`) reach the active instance through :func:`get_telemetry`, a process-wide
+registry that degrades to a no-op when no train loop installed telemetry — inference tools
+and unit tests pay nothing.
+
+On-demand profiling: :class:`OnDemandProfiler` is polled once per step (same pattern as the
+fault-tolerance preemption flag); touching the trigger file — or SIGUSR1 — captures an N-step
+`jax.profiler` trace mid-run, no restart. Steps are labeled via
+:func:`step_annotation` (``jax.profiler.StepTraceAnnotation``) and dataloader/checkpoint/eval
+scopes via :func:`trace_annotation`, so captured traces read as the goodput buckets do.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Any
+
+import jax
+
+from .logger import log_rank_0
+
+SCHEMA_VERSION = 1
+
+# goodput buckets, in reporting order; "other" is the window remainder (python overhead,
+# logging, host syncs) and is derived, never accumulated directly
+GOODPUT_BUCKETS = ("compile", "data", "step", "checkpoint", "eval")
+
+# pre-seeded at 0 in every Telemetry so window records always carry the full
+# fault-tolerance set — a reader can tell "no NaN skips" from "counter not wired"
+CANONICAL_COUNTERS = (
+    "nan_skips",
+    "io_retries",
+    "io_failures",
+    "loader_stalls",
+    "preemptions",
+    "checkpoints_saved",
+    "checkpoints_pruned",
+)
+
+# bf16 peak TFLOPs per JAX device (v2/v3 devices are single TensorCores, half a chip;
+# v4 onward one device == one chip). First substring match on device_kind wins, so more
+# specific entries ("v5 lite") come before their prefixes ("v5").
+_PEAK_TFLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5p", 459.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 61.5),
+    ("v2", 22.5),
+)
+
+
+def detect_peak_tflops_per_device(device=None) -> float | None:
+    """Per-device peak bf16 TFLOPs from `device_kind`, for MFU. `DOLOMITE_PEAK_TFLOPS_PER_DEVICE`
+    overrides (unlisted accelerators, promised-vs-real quotas); None when unknown (CPU) — MFU
+    is then omitted rather than fabricated."""
+    env = os.environ.get("DOLOMITE_PEAK_TFLOPS_PER_DEVICE")
+    if env:
+        return float(env)
+    if device is None:
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for pattern, peak in _PEAK_TFLOPS_BY_KIND:
+        if pattern in kind:
+            return peak
+    return None
+
+
+def collect_memory_gauges() -> dict[str, int]:
+    """Device HBM gauges from `memory_stats()` (absent on CPU backends) + host peak RSS, so
+    the window records show memory even where the device runtime reports none."""
+    gauges: dict[str, int] = {}
+    for i, device in enumerate(jax.local_devices()):
+        try:
+            stats = device.memory_stats()
+        except Exception:  # some backends raise instead of returning None
+            stats = None
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                gauges[f"device{i}/{key}"] = int(stats[key])
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux
+        gauges["host/peak_rss_bytes"] = (
+            int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+        )
+    except Exception:
+        pass
+    return gauges
+
+
+def step_annotation(step: int):
+    """Label one train step in captured traces (`StepTraceAnnotation` groups per-step work in
+    the profiler UI and feeds its step-time histogram)."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+def trace_annotation(name: str):
+    """Named scope in captured traces (dataloader fetch, checkpoint save, eval) matching the
+    goodput bucket names."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class OnDemandProfiler:
+    """Capture an N-step `jax.profiler` trace mid-run, without restarting.
+
+    Armed by either of two triggers, polled once per step by the train loops (the same
+    pattern as the fault-tolerance preemption flag):
+
+    - **touch file**: `touch <trigger_path>` — the poll consumes (deletes) it and starts a
+      trace; works over any shared filesystem and needs no PID.
+    - **SIGUSR1** (when `use_signal`): `kill -USR1 <pid>`.
+
+    The trace covers the next `num_steps` train steps and lands under `output_path`
+    (one subdir per capture, named for its first traced step, so repeated triggers never
+    clobber each other).
+    """
+
+    def __init__(
+        self,
+        trigger_path: str,
+        output_path: str,
+        num_steps: int = 3,
+        use_signal: bool = True,
+    ) -> None:
+        self.trigger_path = trigger_path
+        self.output_path = output_path
+        self.num_steps = max(int(num_steps), 1)
+        self._signal_flag = threading.Event()
+        self._active_since: int | None = None
+        self._captures = 0
+        if use_signal:
+            self._install_signal_handler()
+
+    def _install_signal_handler(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            log_rank_0(
+                logging.WARNING,
+                "SIGUSR1 profile trigger not installed: signal handlers require the main "
+                "thread; the touch-file trigger still works",
+            )
+            return
+        signal.signal(signal.SIGUSR1, lambda signum, frame: self._signal_flag.set())
+
+    def _consume_trigger(self) -> bool:
+        if self._signal_flag.is_set():
+            self._signal_flag.clear()
+            return True
+        if os.path.exists(self.trigger_path):
+            try:
+                os.remove(self.trigger_path)
+            except OSError:
+                pass  # another host on the same mount consumed it first — still triggered
+            return True
+        return False
+
+    @property
+    def active(self) -> bool:
+        return self._active_since is not None
+
+    def poll(self, step: int, telemetry: "Telemetry | None" = None) -> None:
+        """Once per train step, after the step ran: start a capture if triggered, stop one
+        that has covered `num_steps` steps."""
+        if self._active_since is not None:
+            if step - self._active_since >= self.num_steps:
+                self._stop(step, telemetry)
+            return
+        if self._consume_trigger():
+            self._start(step, telemetry)
+
+    def _start(self, step: int, telemetry: "Telemetry | None") -> None:
+        trace_dir = os.path.join(self.output_path, f"step{step + 1}")
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+        except Exception as error:  # a failed capture must never kill training
+            log_rank_0(logging.WARNING, f"on-demand profile failed to start: {error!r}")
+            return
+        self._active_since = step
+        log_rank_0(
+            logging.INFO,
+            f"on-demand profile: tracing {self.num_steps} step(s) from step {step + 1} "
+            f"into {trace_dir}",
+        )
+        if telemetry is not None:
+            telemetry.event("profile_start", step=step, trace_dir=trace_dir)
+
+    def _stop(self, step: int, telemetry: "Telemetry | None") -> None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as error:
+            log_rank_0(logging.WARNING, f"on-demand profile failed to stop: {error!r}")
+        self._active_since = None
+        self._captures += 1
+        log_rank_0(logging.INFO, f"on-demand profile captured through step {step}")
+        if telemetry is not None:
+            telemetry.count("profiles_captured", event=True, step=step)
+
+    def close(self) -> None:
+        """End-of-run cleanup: commit a capture the run ended inside of."""
+        if self._active_since is not None:
+            self._stop(self._active_since + self.num_steps, None)
+
+
+class Telemetry:
+    """Process-local metrics registry -> JSONL sink + rank-0 tracker fanout.
+
+    Counters are cumulative over the run (thread-safe — the stall watchdog increments from
+    its worker thread); gauges are last-value-wins; the goodput buckets accumulate seconds
+    within the current logging window and reset at :meth:`emit_window`.
+
+    The first :meth:`record_step` of a run attributes the whole step duration to the
+    ``compile`` bucket (XLA traces+compiles inside the first call; the one real step
+    execution inside it is noise at compile timescales) and is excluded from steady-state
+    step-time stats and MFU.
+    """
+
+    def __init__(
+        self,
+        sink_path: str | None = None,
+        experiments_tracker=None,
+        model_tflops_per_step: float | None = None,
+        peak_tflops_per_device: float | None = None,
+        devices_per_group: int = 1,
+        profiler: OnDemandProfiler | None = None,
+        rank: int | None = None,
+    ) -> None:
+        self.rank = jax.process_index() if rank is None else rank
+        self.experiments_tracker = experiments_tracker
+        self.model_tflops_per_step = model_tflops_per_step
+        self.peak_tflops_per_device = peak_tflops_per_device
+        self.devices_per_group = max(int(devices_per_group), 1)
+        self.profiler = profiler
+        self.sink_path = sink_path
+
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {name: 0 for name in CANONICAL_COUNTERS}
+        self.gauges: dict[str, Any] = {}
+        self._buckets: dict[str, float] = {k: 0.0 for k in GOODPUT_BUCKETS}
+        self._step_times: list[float] = []
+        self._window_start = time.perf_counter()
+        self._seen_first_step = False
+        self._last_step = 0
+
+        self._file = None
+        if sink_path is not None:
+            sink_dir = os.path.dirname(sink_path)
+            if sink_dir:
+                os.makedirs(sink_dir, exist_ok=True)
+            self._file = open(sink_path, "a")
+
+        device_kinds = sorted({d.device_kind for d in jax.local_devices()})
+        self._emit(
+            {
+                "kind": "run_start",
+                "schema": SCHEMA_VERSION,
+                "devices": jax.device_count(),
+                "device_kind": ", ".join(device_kinds),
+                "peak_tflops_per_device": peak_tflops_per_device,
+                "model_tflops_per_step": model_tflops_per_step,
+            }
+        )
+
+    # ------------------------------------------------------------------ sink
+
+    def _emit(self, record: dict) -> None:
+        """One record = one line, flushed immediately: a SIGKILL mid-run loses at most the
+        line being written, and readers never see interleaved halves (writes under a lock)."""
+        if self._file is None:
+            return
+        record = {"ts": round(time.time(), 3), "rank": self.rank, **record}
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._file is not None and not self._file.closed:
+                self._file.write(line + "\n")
+                self._file.flush()
+
+    # ------------------------------------------------------------------ registry
+
+    def count(
+        self, name: str, value: int = 1, event: bool = False, step: int | None = None
+    ) -> None:
+        """Increment a cumulative counter. `event=True` additionally writes an immediate
+        event record — for increments whose process may die before the next window (loader
+        stall, preemption)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + value
+        if event:
+            self.event(name, step=step, total=self.counters[name])
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def event(self, name: str, step: int | None = None, **fields) -> None:
+        record = {"kind": "event", "event": name}
+        if step is not None:
+            record["step"] = step
+        record.update(fields)
+        self._emit(record)
+
+    @contextmanager
+    def timer(self, bucket: str):
+        """Accumulate a wall-clock scope into a goodput bucket (checkpoint saves, eval)."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._buckets[bucket] = self._buckets.get(bucket, 0.0) + elapsed
+
+    # ------------------------------------------------------------------ goodput
+
+    def record_step(self, step: int, data_seconds: float, step_seconds: float) -> None:
+        """Per-step accounting from the train loops: dataloader wait + jitted-step wall
+        time. Writes a step record and feeds the window buckets."""
+        self._last_step = step
+        timings: dict[str, float] = {"data": round(data_seconds, 6)}
+        with self._lock:
+            self._buckets["data"] += data_seconds
+            if not self._seen_first_step:
+                self._seen_first_step = True
+                self._buckets["compile"] += step_seconds
+                timings["compile"] = round(step_seconds, 6)
+            else:
+                self._buckets["step"] += step_seconds
+                self._step_times.append(step_seconds)
+                timings["step"] = round(step_seconds, 6)
+        self._emit({"kind": "step", "step": step, "t": timings})
+
+    def current_mfu(self) -> float | None:
+        """Steady-state MFU %% over the current window: analytic model TFLOPs per step
+        (`get_model_tflops`, per model-parallel device group) / measured step time, vs the
+        group's aggregate peak. None until a steady step lands or when peak/model FLOPs are
+        unknown."""
+        if not self.model_tflops_per_step or not self.peak_tflops_per_device:
+            return None
+        with self._lock:
+            if not self._step_times:
+                return None
+            mean_step = sum(self._step_times) / len(self._step_times)
+        achieved = self.model_tflops_per_step / mean_step
+        peak = self.peak_tflops_per_device * self.devices_per_group
+        return 100.0 * achieved / peak
+
+    def emit_window(self, step: int) -> dict | None:
+        """Close the current logging window: write the window record (goodput breakdown,
+        step-time stats, MFU, cumulative counters, memory gauges), fan rank-0 scalars out to
+        the experiments tracker, reset the window accumulators."""
+        now = time.perf_counter()
+        mfu = self.current_mfu()
+        for name, value in collect_memory_gauges().items():
+            self.gauge(name, value)
+        with self._lock:
+            wall = max(now - self._window_start, 1e-9)
+            buckets = {k: round(self._buckets.get(k, 0.0), 6) for k in GOODPUT_BUCKETS}
+            accounted = sum(buckets.values())
+            buckets["other"] = round(max(wall - accounted, 0.0), 6)
+            buckets["goodput_pct"] = round(100.0 * self._buckets["step"] / wall, 3)
+            step_times = self._step_times
+            step_stats = None
+            if step_times:
+                step_stats = {
+                    "count": len(step_times),
+                    "mean": round(sum(step_times) / len(step_times), 6),
+                    "min": round(min(step_times), 6),
+                    "max": round(max(step_times), 6),
+                }
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            self._buckets = {k: 0.0 for k in GOODPUT_BUCKETS}
+            self._step_times = []
+            self._window_start = now
+
+        tflops_per_group = None
+        if self.model_tflops_per_step and step_stats:
+            tflops_per_group = round(self.model_tflops_per_step / step_stats["mean"], 3)
+
+        record = {
+            "kind": "window",
+            "step": step,
+            "window_seconds": round(wall, 6),
+            "goodput": buckets,
+            "step_time": step_stats,
+            "mfu_pct": round(mfu, 3) if mfu is not None else None,
+            "tflops_per_group": tflops_per_group,
+            "counters": counters,
+            "gauges": gauges,
+        }
+        self._emit(record)
+
+        if self.experiments_tracker is not None:
+            scalars = {f"goodput/{k}_seconds": v for k, v in buckets.items() if k != "goodput_pct"}
+            scalars["goodput/goodput_pct"] = buckets["goodput_pct"]
+            if mfu is not None:
+                scalars["goodput/mfu_pct"] = round(mfu, 3)
+            for name, value in counters.items():
+                scalars[f"counter/{name}"] = value
+            for name, value in gauges.items():
+                if isinstance(value, (int, float)):
+                    scalars[f"gauge/{name}"] = value
+            self.experiments_tracker.track(scalars, step=step, context="telemetry")
+        return record
+
+    # ------------------------------------------------------------------ profiler
+
+    def poll_profiler(self, step: int) -> None:
+        if self.profiler is not None:
+            self.profiler.poll(step, telemetry=self)
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        if self.profiler is not None:
+            self.profiler.close()
+        self._emit({"kind": "run_end", "step": self._last_step, "counters": dict(self.counters)})
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+class _NullTelemetry:
+    """No-op stand-in returned by :func:`get_telemetry` when no train loop installed a real
+    instance (inference tools, unit tests): cross-module counter calls cost one attribute
+    lookup and nothing else."""
+
+    rank = 0
+    counters: dict[str, int] = {}
+    profiler = None
+
+    def count(self, name, value=1, event=False, step=None) -> None:
+        pass
+
+    def gauge(self, name, value) -> None:
+        pass
+
+    def event(self, name, step=None, **fields) -> None:
+        pass
+
+    def timer(self, bucket):
+        return nullcontext()
+
+    def record_step(self, step, data_seconds, step_seconds) -> None:
+        pass
+
+    def current_mfu(self):
+        return None
+
+    def emit_window(self, step):
+        return None
+
+    def poll_profiler(self, step) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+_NULL = _NullTelemetry()
+_ACTIVE: Telemetry | None = None
+
+
+def install_telemetry(telemetry: Telemetry) -> None:
+    """Make `telemetry` the process-wide instance cross-module counters report to."""
+    global _ACTIVE
+    _ACTIVE = telemetry
+
+
+def uninstall_telemetry() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def get_telemetry() -> Telemetry | _NullTelemetry:
+    """The active instance, or a shared no-op when none is installed."""
+    return _ACTIVE if _ACTIVE is not None else _NULL
+
+
+def build_telemetry(
+    args,
+    experiments_tracker=None,
+    model_tflops_per_step: float | None = None,
+    devices_per_group: int = 1,
+) -> Telemetry:
+    """Construct Telemetry from `args.logging_args.telemetry` (both train loops).
+
+    Default paths hang off `save_args.save_path` so every run directory is self-contained:
+    sink `<save_path>/telemetry/rank-<process>.jsonl`, profile trigger
+    `<save_path>/telemetry/PROFILE_TRIGGER`, traces `<save_path>/telemetry/traces/`.
+    """
+    targs = getattr(args.logging_args, "telemetry", None)
+    save_args = getattr(args, "save_args", None)
+    save_path = getattr(save_args, "save_path", None)
+
+    sink_path = None
+    profiler = None
+    peak_override = None
+    if targs is not None:
+        peak_override = targs.peak_tflops_per_device
+        if targs.jsonl_sink:
+            sink_path = targs.jsonl_path
+            if sink_path is None and save_path is not None:
+                sink_path = os.path.join(
+                    save_path, "telemetry", f"rank-{jax.process_index():05d}.jsonl"
+                )
+        if targs.on_demand_profiling:
+            trigger = targs.profile_trigger_path
+            output = targs.profile_output_path
+            if trigger is None and save_path is not None:
+                trigger = os.path.join(save_path, "telemetry", "PROFILE_TRIGGER")
+            if output is None and save_path is not None:
+                output = os.path.join(save_path, "telemetry", "traces")
+            if trigger is not None and output is not None:
+                profiler = OnDemandProfiler(
+                    trigger,
+                    output,
+                    num_steps=targs.profile_steps,
+                    use_signal=targs.profile_on_sigusr1,
+                )
+            else:
+                log_rank_0(
+                    logging.WARNING,
+                    "on-demand profiling disabled: no trigger/output path (set "
+                    "logging_args.telemetry.profile_trigger_path/profile_output_path or "
+                    "save_args.save_path)",
+                )
+
+    return Telemetry(
+        sink_path=sink_path,
+        experiments_tracker=experiments_tracker,
+        model_tflops_per_step=model_tflops_per_step,
+        peak_tflops_per_device=peak_override or detect_peak_tflops_per_device(),
+        devices_per_group=devices_per_group,
+        profiler=profiler,
+    )

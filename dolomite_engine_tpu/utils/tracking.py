@@ -36,6 +36,15 @@ class ProgressBar:
             self._bar.n = step
             self._bar.refresh()
 
+    def set_postfix(self, **metrics) -> None:
+        """Live loss/throughput readout next to the bar. Called at log intervals only —
+        formatting a postfix every step would sync device scalars the loop keeps async."""
+        if self._bar is not None:
+            formatted = {
+                k: (f"{v:.4g}" if isinstance(v, float) else v) for k, v in metrics.items()
+            }
+            self._bar.set_postfix(formatted, refresh=False)
+
 
 class ExperimentsTracker:
     def __init__(

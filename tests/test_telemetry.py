@@ -1,0 +1,573 @@
+"""Telemetry layer tests (ISSUE 2 tentpole): JSONL sink round-trip and schema, goodput
+window accounting math, on-demand profiler trigger polling, cross-module counter wiring
+(retry/fault-tolerance/checkpointing), the fixed profiler-schedule fix, and a tiny
+train-loop smoke run guarding the sink against partial-write corruption.
+
+All CPU-only pytrees — no sharded-model paths (those are broken at seed, see memory)."""
+
+import importlib.util
+import json
+import os
+import signal
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dolomite_engine_tpu import finetune, train_utils
+from dolomite_engine_tpu.arguments import TrainingArgs
+from dolomite_engine_tpu.checkpointing import save_checkpoint
+from dolomite_engine_tpu.train_utils import (
+    TrainState,
+    get_profiler_context,
+    handle_nonfinite_step,
+    reset_profiler_schedule,
+)
+from dolomite_engine_tpu.utils import StallWatchdog, retry_io
+from dolomite_engine_tpu.utils.telemetry import (
+    OnDemandProfiler,
+    Telemetry,
+    build_telemetry,
+    detect_peak_tflops_per_device,
+    get_telemetry,
+    install_telemetry,
+    uninstall_telemetry,
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_summary_tool():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_summary", os.path.join(_REPO_ROOT, "tools", "telemetry_summary.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _read_sink(path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    uninstall_telemetry()
+    reset_profiler_schedule()
+    yield
+    uninstall_telemetry()
+    reset_profiler_schedule()
+
+
+# --------------------------------------------------------------------------- sink schema
+
+
+def test_sink_round_trip_and_schema(tmp_path):
+    sink = tmp_path / "telemetry" / "rank-00000.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    telemetry.count("io_retries", 2)
+    telemetry.gauge("custom", 7)
+    telemetry.event("nan_skips", step=3, total=1)
+    telemetry.record_step(1, data_seconds=0.25, step_seconds=2.0)  # first step -> compile
+    telemetry.record_step(2, data_seconds=0.25, step_seconds=0.5)
+    telemetry.emit_window(2)
+    telemetry.close()
+
+    records = _read_sink(sink)
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["run_start", "event", "step", "step", "window", "run_end"]
+    # every record is rank-tagged and timestamped
+    assert all(r["rank"] == 0 and "ts" in r for r in records)
+
+    run_start = records[0]
+    assert run_start["schema"] == 1
+    assert run_start["devices"] == jax.device_count()
+
+    first_step, second_step = records[2], records[3]
+    assert first_step["step"] == 1 and "compile" in first_step["t"]
+    assert "step" not in first_step["t"]  # first-step wall time is all compile
+    assert second_step["t"]["step"] == pytest.approx(0.5)
+
+    window = records[4]
+    assert window["counters"]["io_retries"] == 2
+    assert window["counters"]["nan_skips"] == 0  # canonical set pre-seeded at 0
+    assert window["gauges"]["custom"] == 7
+    assert records[-1]["kind"] == "run_end"
+    assert records[-1]["counters"]["io_retries"] == 2
+
+
+def test_sink_none_is_noop_but_registry_still_counts():
+    telemetry = Telemetry(sink_path=None, rank=0)
+    telemetry.count("nan_skips", event=True, step=1)
+    telemetry.record_step(1, 0.1, 0.1)
+    assert telemetry.emit_window(1) is not None
+    telemetry.close()
+    assert telemetry.counters["nan_skips"] == 1
+
+
+# --------------------------------------------------------------------------- goodput math
+
+
+def test_goodput_window_accounting_and_mfu(tmp_path):
+    telemetry = Telemetry(
+        sink_path=str(tmp_path / "t.jsonl"),
+        model_tflops_per_step=10.0,  # 10 TFLOPs per step per group
+        peak_tflops_per_device=100.0,
+        devices_per_group=2,
+        rank=0,
+    )
+    telemetry.record_step(1, data_seconds=1.0, step_seconds=5.0)  # compile
+    telemetry.record_step(2, data_seconds=1.0, step_seconds=0.5)
+    telemetry.record_step(3, data_seconds=1.0, step_seconds=0.3)
+
+    # steady mean step = 0.4s -> 25 TFLOPs/group achieved vs 200 peak -> 12.5% MFU
+    assert telemetry.current_mfu() == pytest.approx(12.5)
+
+    with telemetry.timer("checkpoint"):
+        pass
+    window = telemetry.emit_window(3)
+    goodput = window["goodput"]
+    assert goodput["compile"] == pytest.approx(5.0)
+    assert goodput["data"] == pytest.approx(3.0)
+    assert goodput["step"] == pytest.approx(0.8)
+    assert window["step_time"] == {"count": 2, "mean": 0.4, "min": 0.3, "max": 0.5}
+    assert window["mfu_pct"] == pytest.approx(12.5)
+    assert window["tflops_per_group"] == pytest.approx(25.0)
+    # wall is real elapsed time (tiny here), so "other" >= 0 and buckets don't exceed wall
+    assert goodput["other"] >= 0.0
+
+    # window accumulators reset; counters are cumulative
+    telemetry.count("nan_skips")
+    assert telemetry.current_mfu() is None  # no steady steps in the new window yet
+    window2 = telemetry.emit_window(4)
+    assert window2["goodput"]["compile"] == 0.0
+    assert window2["counters"]["nan_skips"] == 1
+    telemetry.close()
+
+
+def test_mfu_none_without_peak_or_model_flops():
+    telemetry = Telemetry(sink_path=None, model_tflops_per_step=None, rank=0)
+    telemetry.record_step(1, 0.1, 0.1)
+    telemetry.record_step(2, 0.1, 0.1)
+    assert telemetry.current_mfu() is None
+    telemetry.close()
+
+
+def test_tracker_fanout_scalars(tmp_path):
+    tracked = []
+
+    class _Tracker:
+        def track(self, values, step=None, context=None):
+            tracked.append((values, step, context))
+
+    telemetry = Telemetry(
+        sink_path=None,
+        experiments_tracker=_Tracker(),
+        model_tflops_per_step=1.0,
+        peak_tflops_per_device=10.0,
+        rank=0,
+    )
+    telemetry.record_step(1, 0.1, 0.1)
+    telemetry.record_step(2, 0.1, 0.1)
+    telemetry.count("io_retries")
+    telemetry.emit_window(2)
+    telemetry.close()
+
+    assert len(tracked) == 1
+    values, step, context = tracked[0]
+    assert step == 2 and context == "telemetry"
+    assert "goodput/goodput_pct" in values
+    assert "goodput/mfu_pct" in values
+    assert values["counter/io_retries"] == 1
+
+
+def test_detect_peak_tflops_env_override(monkeypatch):
+    monkeypatch.setenv("DOLOMITE_PEAK_TFLOPS_PER_DEVICE", "123.5")
+    assert detect_peak_tflops_per_device() == 123.5
+    monkeypatch.delenv("DOLOMITE_PEAK_TFLOPS_PER_DEVICE")
+
+    class _FakeDevice:
+        device_kind = "TPU v4"
+
+    assert detect_peak_tflops_per_device(_FakeDevice()) == 275.0
+    _FakeDevice.device_kind = "TPU v5 lite"
+    assert detect_peak_tflops_per_device(_FakeDevice()) == 197.0
+    _FakeDevice.device_kind = "cpu"
+    assert detect_peak_tflops_per_device(_FakeDevice()) is None
+
+
+# --------------------------------------------------------------------------- on-demand profiler
+
+
+@pytest.fixture()
+def _fake_profiler(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda path: calls.append(("start", path)))
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: calls.append(("stop", None)))
+    return calls
+
+
+def test_on_demand_touch_file_trigger(tmp_path, _fake_profiler):
+    trigger = tmp_path / "PROFILE_TRIGGER"
+    profiler = OnDemandProfiler(
+        str(trigger), str(tmp_path / "traces"), num_steps=2, use_signal=False
+    )
+    sink = tmp_path / "t.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), profiler=profiler, rank=0)
+
+    telemetry.poll_profiler(1)
+    assert _fake_profiler == []  # no trigger yet
+
+    trigger.touch()
+    telemetry.poll_profiler(2)  # consumes the trigger, starts the capture
+    assert not trigger.exists()
+    assert _fake_profiler == [("start", str(tmp_path / "traces" / "step3"))]
+    assert profiler.active
+
+    telemetry.poll_profiler(3)  # 1 step covered, window not done
+    assert len(_fake_profiler) == 1
+    telemetry.poll_profiler(4)  # 2 steps covered -> stop
+    assert _fake_profiler[-1] == ("stop", None)
+    assert not profiler.active
+    assert telemetry.counters["profiles_captured"] == 1
+
+    events = [r for r in _read_sink(sink) if r["kind"] == "event"]
+    assert [e["event"] for e in events] == ["profile_start", "profiles_captured"]
+    telemetry.close()
+
+
+def test_on_demand_sigusr1_trigger(tmp_path, _fake_profiler):
+    previous = signal.getsignal(signal.SIGUSR1)
+    try:
+        profiler = OnDemandProfiler(
+            str(tmp_path / "trigger"), str(tmp_path / "traces"), num_steps=1, use_signal=True
+        )
+        os.kill(os.getpid(), signal.SIGUSR1)
+        import time
+
+        deadline = time.time() + 2
+        while not profiler._signal_flag.is_set() and time.time() < deadline:
+            time.sleep(0.01)
+        profiler.poll(5)
+        assert _fake_profiler and _fake_profiler[0][0] == "start"
+        profiler.poll(6)
+        assert _fake_profiler[-1][0] == "stop"
+    finally:
+        signal.signal(signal.SIGUSR1, previous)
+
+
+def test_on_demand_close_commits_in_flight_capture(tmp_path, _fake_profiler):
+    profiler = OnDemandProfiler(
+        str(tmp_path / "trigger"), str(tmp_path / "traces"), num_steps=10, use_signal=False
+    )
+    (tmp_path / "trigger").touch()
+    profiler.poll(1)
+    assert profiler.active
+    profiler.close()  # run ended mid-capture: the trace must still be committed
+    assert _fake_profiler[-1][0] == "stop"
+    assert not profiler.active
+
+
+def test_failed_capture_start_never_kills_training(tmp_path, monkeypatch):
+    def boom(path):
+        raise RuntimeError("profiler backend unavailable")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    profiler = OnDemandProfiler(
+        str(tmp_path / "trigger"), str(tmp_path / "traces"), num_steps=1, use_signal=False
+    )
+    (tmp_path / "trigger").touch()
+    profiler.poll(1)  # must swallow the error
+    assert not profiler.active
+
+
+# --------------------------------------------------------------------------- counter wiring
+
+
+def test_retry_io_counts_retries_and_failures(tmp_path):
+    telemetry = Telemetry(sink_path=str(tmp_path / "t.jsonl"), rank=0)
+    install_telemetry(telemetry)
+
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("blip")
+        return "ok"
+
+    assert retry_io(flaky, attempts=3, sleep=lambda d: None) == "ok"
+    assert telemetry.counters["io_retries"] == 2
+
+    with pytest.raises(OSError):
+        retry_io(lambda: (_ for _ in ()).throw(OSError("down")), attempts=2, sleep=lambda d: None)
+    assert telemetry.counters["io_retries"] == 3
+    assert telemetry.counters["io_failures"] == 1
+    events = [r for r in _read_sink(tmp_path / "t.jsonl") if r["kind"] == "event"]
+    assert any(e["event"] == "io_failures" for e in events)
+    telemetry.close()
+
+
+def test_nonfinite_step_counts_nan_skips(tmp_path):
+    telemetry = Telemetry(sink_path=str(tmp_path / "t.jsonl"), rank=0)
+    install_telemetry(telemetry)
+    consecutive = handle_nonfinite_step(True, 0, global_step=7, max_consecutive=10)
+    assert consecutive == 1
+    handle_nonfinite_step(False, consecutive, global_step=8, max_consecutive=10)
+    assert telemetry.counters["nan_skips"] == 1
+    events = [r for r in _read_sink(tmp_path / "t.jsonl") if r["kind"] == "event"]
+    assert events[0]["event"] == "nan_skips" and events[0]["step"] == 7
+    telemetry.close()
+
+
+def test_stall_watchdog_counts_loader_stalls(tmp_path):
+    telemetry = Telemetry(sink_path=str(tmp_path / "t.jsonl"), rank=0)
+    install_telemetry(telemetry)
+    release = threading.Event()
+
+    def hung():
+        yield 1
+        release.wait(30)
+
+    watchdog = StallWatchdog(hung(), timeout_seconds=0.2)
+    assert next(watchdog) == 1
+    with pytest.raises(RuntimeError, match="stalled"):
+        next(watchdog)
+    release.set()
+    watchdog.close()
+    assert telemetry.counters["loader_stalls"] == 1
+    telemetry.close()
+
+
+def test_checkpoint_save_and_prune_counters(tmp_path):
+    telemetry = Telemetry(sink_path=None, rank=0)
+    install_telemetry(telemetry)
+
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    optimizer = optax.sgd(1e-2)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
+    )
+    args = TrainingArgs(
+        model_args=dict(
+            model_class="AutoModelForCausalLM",
+            pretrained_config=dict(
+                model_type="gpt_dolomite", vocab_size=8, n_positions=8, n_embd=4,
+                n_layer=1, n_head=1,
+            ),
+        ),
+        tuning_args=dict(tuning_method="full_finetuning"),
+        training_parameters=dict(
+            num_training_steps=5, micro_batch_size=2, eval_during_training=False
+        ),
+        datasets=[dict(class_name="DebugDataset", data_name="debug", class_args={})],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=1, keep_last_n=1),
+    )
+    save_checkpoint(args, None, state, None, None, iteration=1)
+    save_checkpoint(args, None, state, None, None, iteration=2)  # prunes global_step1
+    assert telemetry.counters["checkpoints_saved"] == 2
+    assert telemetry.counters["checkpoints_pruned"] == 1
+    telemetry.close()
+
+
+def test_null_registry_is_safe_without_install():
+    null = get_telemetry()
+    null.count("anything", event=True, step=1)
+    null.record_step(1, 0.1, 0.1)
+    with null.timer("checkpoint"):
+        pass
+    assert null.emit_window(1) is None
+    assert null.current_mfu() is None
+    null.poll_profiler(1)
+    null.close()
+
+
+# --------------------------------------------------------------------------- fixed profiler schedule
+
+
+def test_profiler_schedule_absolute_and_one_shot(monkeypatch):
+    from contextlib import nullcontext as _nullcm
+
+    traces = []
+    monkeypatch.setattr(
+        jax.profiler, "trace", lambda path: traces.append(path) or _nullcm()
+    )
+
+    # fresh run: steps 1..5 skipped, step 6 traced, then done
+    for step in range(1, 6):
+        with get_profiler_context("/tmp/trace", step):
+            pass
+    assert traces == []
+    with get_profiler_context("/tmp/trace", 6):
+        pass
+    assert traces == ["/tmp/trace"]
+    # one-shot: the window never re-captures, even if the step moves backwards
+    with get_profiler_context("/tmp/trace", 6):
+        pass
+    assert traces == ["/tmp/trace"]
+
+    # resumed run past the window: never captures
+    reset_profiler_schedule()
+    with get_profiler_context("/tmp/trace", 100):
+        pass
+    with get_profiler_context("/tmp/trace", 6):  # even a backwards step after the latch
+        pass
+    assert traces == ["/tmp/trace"]
+
+    # no trace path -> never anything
+    reset_profiler_schedule()
+    with get_profiler_context(None, 6):
+        pass
+    assert traces == ["/tmp/trace"]
+
+
+# --------------------------------------------------------------------------- smoke: real train loop
+
+
+class _Model:
+    def loss(self, params, batch, rngs=None, train=True, fp8_state=None):
+        return jnp.mean(params["w"] * batch["x"])
+
+
+class _Loader:
+    def __init__(self, n=4):
+        self.n = n
+
+    def __iter__(self):
+        for _ in range(self.n):
+            yield {"x": np.ones((2, 4), np.float32)}
+
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, sd):
+        pass
+
+
+def _train_args(tmp_path, num_steps=6, **logging_kwargs):
+    return TrainingArgs(
+        model_args=dict(
+            model_class="AutoModelForCausalLM",
+            pretrained_config=dict(
+                model_type="gpt_dolomite", vocab_size=8, n_positions=8, n_embd=4,
+                n_layer=1, n_head=1,
+            ),
+        ),
+        tuning_args=dict(tuning_method="full_finetuning"),
+        training_parameters=dict(
+            num_training_steps=num_steps,
+            micro_batch_size=2,
+            gradient_accumulation_steps=1,
+            eval_during_training=False,
+        ),
+        datasets=[dict(class_name="DebugDataset", data_name="debug", class_args={})],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=3),
+        logging_args=dict(log_interval=2, **logging_kwargs),
+        random_args=dict(seed=3),
+    )
+
+
+def _run_loop(args):
+    params = {"w": jnp.ones((4,), jnp.float32), "b": jnp.zeros((2,), jnp.float32)}
+    optimizer = optax.adam(1e-2)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
+    )
+    finetune.train(
+        args, _Model(), state, optimizer, lambda step: 1e-2, _Loader(), None,
+        experiments_tracker=None,
+    )
+
+
+def test_smoke_train_loop_sink_valid_and_monotone(tmp_path):
+    """CI guard for the sink format: one tiny real train loop with default telemetry, then
+    every line must parse as JSON and step records must be strictly monotone."""
+    _run_loop(_train_args(tmp_path))
+
+    sink = tmp_path / "ckpt" / "telemetry" / "rank-00000.jsonl"
+    assert sink.is_file()
+    records = _read_sink(sink)  # json.loads raises on any torn/partial line
+
+    kinds = {r["kind"] for r in records}
+    assert {"run_start", "step", "window", "run_end"} <= kinds
+
+    steps = [r["step"] for r in records if r["kind"] == "step"]
+    assert steps == sorted(steps) and len(set(steps)) == len(steps)  # strictly monotone
+    assert steps == list(range(1, 7))
+
+    windows = [r for r in records if r["kind"] == "window"]
+    assert [w["step"] for w in windows] == [2, 4, 6]
+    for window in windows:
+        assert set(window["goodput"]) == {
+            "compile", "data", "step", "checkpoint", "eval", "other", "goodput_pct"
+        }
+    # the save at step 3 lands in the step-4 window; the save at step 6 in its own
+    assert windows[1]["goodput"]["checkpoint"] > 0.0
+    assert windows[1]["counters"]["checkpoints_saved"] == 1
+    assert windows[2]["counters"]["checkpoints_saved"] == 2
+    # registry is uninstalled after the loop
+    assert get_telemetry().__class__.__name__ == "_NullTelemetry"
+
+
+def test_smoke_summary_tool_renders(tmp_path, capsys):
+    _run_loop(_train_args(tmp_path))
+    tool = _load_summary_tool()
+    assert tool.main([str(tmp_path / "ckpt")]) == 0
+    out = capsys.readouterr().out
+    assert "goodput" in out and "checkpoints_saved" in out
+    assert "train step (steady)" in out
+
+
+def test_on_demand_capture_in_real_loop(tmp_path, _fake_profiler):
+    """Touch-file trigger wired through args -> build_telemetry -> the real finetune loop."""
+    trigger = tmp_path / "ckpt" / "telemetry" / "PROFILE_TRIGGER"
+    trigger.parent.mkdir(parents=True)
+    trigger.touch()
+    args = _train_args(
+        tmp_path,
+        telemetry=dict(on_demand_profiling=True, profile_steps=2, profile_on_sigusr1=False),
+    )
+    _run_loop(args)
+
+    assert [c[0] for c in _fake_profiler] == ["start", "stop"]
+    assert not trigger.exists()
+    records = _read_sink(tmp_path / "ckpt" / "telemetry" / "rank-00000.jsonl")
+    events = [r["event"] for r in records if r["kind"] == "event"]
+    assert "profile_start" in events and "profiles_captured" in events
+
+
+def test_build_telemetry_derives_paths(tmp_path):
+    args = _train_args(tmp_path, telemetry=dict(on_demand_profiling=True))
+    telemetry = build_telemetry(args, model_tflops_per_step=1.0, devices_per_group=2)
+    assert telemetry.sink_path == str(
+        tmp_path / "ckpt" / "telemetry" / f"rank-{jax.process_index():05d}.jsonl"
+    )
+    assert telemetry.profiler is not None
+    assert telemetry.profiler.trigger_path == str(
+        tmp_path / "ckpt" / "telemetry" / "PROFILE_TRIGGER"
+    )
+    assert telemetry.profiler.output_path == str(tmp_path / "ckpt" / "telemetry" / "traces")
+    assert telemetry.devices_per_group == 2
+    telemetry.close()
+
+
+def test_telemetry_args_validation():
+    with pytest.raises(Exception):
+        _train_args_bad = TrainingArgs(
+            model_args=dict(
+                model_class="AutoModelForCausalLM",
+                pretrained_config=dict(
+                    model_type="gpt_dolomite", vocab_size=8, n_positions=8, n_embd=4,
+                    n_layer=1, n_head=1,
+                ),
+            ),
+            tuning_args=dict(tuning_method="full_finetuning"),
+            training_parameters=dict(num_training_steps=5, micro_batch_size=2),
+            datasets=[dict(class_name="DebugDataset", data_name="debug", class_args={})],
+            save_args=dict(save_path="/tmp/x", save_interval=1),
+            logging_args=dict(telemetry=dict(profile_steps=0)),
+        )

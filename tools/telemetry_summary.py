@@ -1,0 +1,188 @@
+"""Render a run's JSONL telemetry sink into markdown tables.
+
+    python tools/telemetry_summary.py <run_dir | telemetry_dir | *.jsonl> [...]
+
+Accepts one or more sink files, or directories (a run's save_path or its `telemetry/`
+subdir — every `*.jsonl` underneath is read and merged, so multi-host runs summarize in one
+call). Output is paste-ready for PROFILE.md / bench reports: step-time percentiles
+(steady-state, first-step compile excluded), the goodput breakdown as a % of wall-clock,
+MFU, and cumulative counter totals.
+
+Schema: docs/OBSERVABILITY.md (`dolomite_engine_tpu/utils/telemetry.py` writes it).
+Malformed lines — the one line a SIGKILL may tear — are counted and skipped, never fatal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def find_sink_files(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            found = sorted(
+                glob.glob(os.path.join(path, "**", "*.jsonl"), recursive=True)
+            )
+            files.extend(found)
+        else:
+            files.append(path)
+    # de-dup while keeping order (a dir arg plus an explicit file inside it)
+    seen: set[str] = set()
+    unique = []
+    for f in files:
+        real = os.path.realpath(f)
+        if real not in seen:
+            seen.add(real)
+            unique.append(f)
+    return unique
+
+
+def read_records(files: list[str]) -> tuple[list[dict], int]:
+    """All parseable records across the sinks, plus the count of torn/invalid lines."""
+    records: list[dict] = []
+    bad_lines = 0
+    for path in files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    bad_lines += 1
+                    continue
+                if isinstance(record, dict):
+                    records.append(record)
+                else:
+                    bad_lines += 1
+    return records, bad_lines
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (no numpy dependency needed)."""
+    if not sorted_values:
+        return float("nan")
+    rank = max(int(round(q / 100.0 * len(sorted_values) + 0.5)) - 1, 0)
+    return sorted_values[min(rank, len(sorted_values) - 1)]
+
+
+def summarize(records: list[dict]) -> str:
+    steps = [r for r in records if r.get("kind") == "step"]
+    windows = [r for r in records if r.get("kind") == "window"]
+    events = [r for r in records if r.get("kind") == "event"]
+    run_starts = [r for r in records if r.get("kind") == "run_start"]
+    run_ends = [r for r in records if r.get("kind") == "run_end"]
+
+    lines: list[str] = []
+
+    if run_starts:
+        first = run_starts[0]
+        lines.append(
+            f"run: {first.get('devices', '?')} device(s) [{first.get('device_kind', '?')}], "
+            f"peak {first.get('peak_tflops_per_device') or 'n/a'} TFLOPs/device, "
+            f"model {first.get('model_tflops_per_step') or 'n/a'} TFLOPs/step"
+        )
+        lines.append("")
+
+    # ---------------------------------------------------------------- step times
+    steady = sorted(t["step"] for r in steps if "step" in (t := r.get("t", {})))
+    compiles = [t["compile"] for r in steps if "compile" in (t := r.get("t", {}))]
+    data_waits = sorted(t["data"] for r in steps if "data" in (t := r.get("t", {})))
+    if steady or compiles:
+        lines.append("| step time (s) | p50 | p95 | max | n |")
+        lines.append("|---|---|---|---|---|")
+        if steady:
+            lines.append(
+                f"| train step (steady) | {percentile(steady, 50):.4g} "
+                f"| {percentile(steady, 95):.4g} | {steady[-1]:.4g} | {len(steady)} |"
+            )
+        if data_waits:
+            lines.append(
+                f"| dataloader wait | {percentile(data_waits, 50):.4g} "
+                f"| {percentile(data_waits, 95):.4g} | {data_waits[-1]:.4g} "
+                f"| {len(data_waits)} |"
+            )
+        if compiles:
+            lines.append(
+                f"| first-step compile | {max(compiles):.4g} | - | {max(compiles):.4g} "
+                f"| {len(compiles)} |"
+            )
+        lines.append("")
+
+    # ---------------------------------------------------------------- goodput
+    if windows:
+        totals = {
+            k: sum(w["goodput"].get(k, 0.0) for w in windows if w.get("goodput"))
+            for k in ("compile", "data", "step", "checkpoint", "eval", "other")
+        }
+        wall = sum(w.get("window_seconds", 0.0) for w in windows) or 1e-9
+        lines.append(f"| goodput bucket | seconds | % of wall ({wall:.4g}s) |")
+        lines.append("|---|---|---|")
+        for name, seconds in totals.items():
+            lines.append(f"| {name} | {seconds:.4g} | {100.0 * seconds / wall:.1f}% |")
+        lines.append("")
+
+        mfus = [w["mfu_pct"] for w in windows if w.get("mfu_pct") is not None]
+        summary = [f"goodput = {100.0 * totals['step'] / wall:.1f}%"]
+        if mfus:
+            summary.append(
+                f"MFU = {sum(mfus) / len(mfus):.2f}% mean "
+                f"({min(mfus):.2f}-{max(mfus):.2f}% over {len(mfus)} windows)"
+            )
+        lines.append("**" + ", ".join(summary) + "**")
+        lines.append("")
+
+    # ---------------------------------------------------------------- counters
+    # last-window/run_end counters are cumulative; merge max-per-name across ranks
+    counters: dict[str, int] = {}
+    for record in windows + run_ends:
+        for name, value in (record.get("counters") or {}).items():
+            counters[name] = max(counters.get(name, 0), int(value))
+    if counters:
+        lines.append("| counter | total |")
+        lines.append("|---|---|")
+        for name in sorted(counters):
+            lines.append(f"| {name} | {counters[name]} |")
+        lines.append("")
+
+    if events:
+        names: dict[str, int] = {}
+        for e in events:
+            names[e.get("event", "?")] = names.get(e.get("event", "?"), 0) + 1
+        lines.append(
+            "events: " + ", ".join(f"{k} x{v}" for k, v in sorted(names.items()))
+        )
+        lines.append("")
+
+    if not (steps or windows or events or run_starts):
+        lines.append("(no telemetry records found)")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "paths", nargs="+", help="sink .jsonl file(s) or run/telemetry directories"
+    )
+    parsed = parser.parse_args(argv)
+
+    files = find_sink_files(parsed.paths)
+    if not files:
+        print(f"no .jsonl sinks found under {parsed.paths}", file=sys.stderr)
+        return 1
+    records, bad_lines = read_records(files)
+    print(f"telemetry summary over {len(files)} sink(s), {len(records)} records\n")
+    print(summarize(records))
+    if bad_lines:
+        print(f"({bad_lines} malformed line(s) skipped)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
