@@ -455,6 +455,49 @@ class WandBArgs(BaseArgs):
         _check_not_None([(self.project, "project"), (self.name, "name")])
 
 
+class HealthArgs(BaseArgs):
+    """Training health monitor (docs/OBSERVABILITY.md, `utils/diagnostics.py`): per-layer-group
+    tensor stats computed inside the jitted step, rolling anomaly detection over
+    loss/grad-norm/step-time, and a crash flight recorder."""
+
+    # steps between `health` records (per-group grad/param norms + update/param ratios,
+    # computed inside the jitted step). 0 (default) disables: the step HLO is unchanged and
+    # no per-step host sync is added. Any value > 0 syncs loss/grad-norm every step (same
+    # cost as fault_tolerance_args.skip_nonfinite_steps)
+    interval: int = 0
+    # EWMA smoothing factor for the loss/grad-norm running moments
+    ewma_alpha: float = 0.05
+    # |z-score| at which a loss/grad-norm sample is flagged as an `anomaly` event
+    zscore_threshold: float = 6.0
+    # samples per signal before z-scoring starts (cold moments are meaningless)
+    warmup_steps: int = 20
+    # rolling window of steady step times for the straggler median
+    straggler_window: int = 50
+    # flag a step slower than this multiple of the rolling-median step time
+    straggler_factor: float = 2.0
+    # escalate to the fault-tolerance abort path (RuntimeError + flight-record dump) after
+    # this many CONSECUTIVE anomalous steps; None (default) only reports
+    abort_after_consecutive_anomalies: int | None = None
+    # ring-buffer capacity of the crash flight recorder: the last N step records dumped to
+    # <save_path>/telemetry/flight-record-rank-<N>.json on crash/stall/NaN-abort; 0 disables
+    flight_recorder_steps: int = 256
+
+    def model_post_init(self, __context: Any) -> None:
+        assert self.interval >= 0, "health.interval must be >= 0 (0 disables)"
+        assert 0.0 < self.ewma_alpha <= 1.0, "health.ewma_alpha must be in (0, 1]"
+        assert self.zscore_threshold > 0, "health.zscore_threshold must be positive"
+        assert self.warmup_steps >= 1, "health.warmup_steps must be >= 1"
+        assert self.straggler_window >= 2, "health.straggler_window must be >= 2"
+        assert self.straggler_factor > 1.0, "health.straggler_factor must be > 1"
+        assert (
+            self.abort_after_consecutive_anomalies is None
+            or self.abort_after_consecutive_anomalies >= 1
+        ), "health.abort_after_consecutive_anomalies must be >= 1 or None"
+        assert self.flight_recorder_steps >= 0, (
+            "health.flight_recorder_steps must be >= 0 (0 disables)"
+        )
+
+
 class TelemetryArgs(BaseArgs):
     """Always-on structured telemetry (docs/OBSERVABILITY.md): goodput breakdown, MFU,
     device-memory gauges, and fault-tolerance counters land in a per-host JSONL sink with no
@@ -479,6 +522,9 @@ class TelemetryArgs(BaseArgs):
     # per-device peak TFLOPs for MFU; None auto-detects from device_kind (TPU v2-v6e table,
     # utils/telemetry.py), or set DOLOMITE_PEAK_TFLOPS_PER_DEVICE
     peak_tflops_per_device: float | None = None
+    # training health monitor: per-layer-group tensor stats, anomaly detection, crash
+    # flight recorder (stats collection off by default; flight recorder on)
+    health: HealthArgs = HealthArgs()
 
     def model_post_init(self, __context: Any) -> None:
         assert self.profile_steps >= 1, "profile_steps must be >= 1"

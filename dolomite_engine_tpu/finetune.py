@@ -44,17 +44,22 @@ from .utils import (
     ExperimentsTracker,
     ProgressBar,
     StallWatchdog,
+    build_health_monitor,
     build_telemetry,
+    crash_reason,
+    emit_model_report,
     init_distributed,
     install_preemption_handler,
     install_telemetry,
     log_rank_0,
     preemption_requested,
+    register_crash_hook,
     setup_tf32,
     step_annotation,
     trace_annotation,
     uninstall_preemption_handler,
     uninstall_telemetry,
+    unregister_crash_hook,
 )
 
 
@@ -117,6 +122,18 @@ def train(
         rngs = None if rng is None else {"dropout": rng, "neft": rng}
         return model.loss(params, micro_batch, rngs=rngs, train=True, fp8_state=fp8_state)
 
+    # always-on telemetry (docs/OBSERVABILITY.md): goodput breakdown per logging window into
+    # the per-host JSONL sink, counters from the fault-tolerance/checkpoint layers,
+    # on-demand profiling. No analytic FLOPs model for variable-length finetune batches, so
+    # MFU is omitted here (pretrain reports it). The health monitor rides the same sink:
+    # per-group tensor stats in the jitted step (when health.interval > 0), anomaly
+    # detection, crash flight recorder.
+    telemetry = build_telemetry(args, experiments_tracker)
+    install_telemetry(telemetry)
+    monitor = build_health_monitor(args, telemetry)
+    register_crash_hook(monitor.dump_flight_record)
+    emit_model_report(telemetry, state)
+
     offload = _resolve_cpu_offload(args)
     jit_kwargs = _offload_jit_kwargs(state) if offload else {}
     train_step = jax.jit(
@@ -127,6 +144,7 @@ def train(
             gradient_clipping=args.training_parameters.gradient_clipping,
             offload_optimizer=offload,
             skip_nonfinite=ft_args.skip_nonfinite_steps,
+            collect_health=monitor.wants_step_metrics,
         ),
         donate_argnums=(0,),
         **jit_kwargs,
@@ -141,13 +159,6 @@ def train(
 
     if jax_rng is None:
         jax_rng = jax.random.PRNGKey(args.random_args.seed)
-
-    # always-on telemetry (docs/OBSERVABILITY.md): goodput breakdown per logging window into
-    # the per-host JSONL sink, counters from the fault-tolerance/checkpoint layers,
-    # on-demand profiling. No analytic FLOPs model for variable-length finetune batches, so
-    # MFU is omitted here (pretrain reports it).
-    telemetry = build_telemetry(args, experiments_tracker)
-    install_telemetry(telemetry)
 
     if eval_during_training and starting_iteration == 0:
         with telemetry.timer("eval"), trace_annotation("eval"):
@@ -176,6 +187,7 @@ def train(
     last_saved_step = None
     consecutive_nonfinite = 0
     preempted = False
+    exit_status = "ok"
     try:
         while global_step < num_training_steps:
             global_step += 1
@@ -198,25 +210,40 @@ def train(
             if ft_args.skip_nonfinite_steps:
                 # host sync per step — the price of counting consecutive skips promptly
                 step_skipped = bool(metrics["skipped"])
-                consecutive_nonfinite = handle_nonfinite_step(
-                    step_skipped,
-                    consecutive_nonfinite,
-                    global_step,
-                    ft_args.max_consecutive_nonfinite_steps,
-                )
 
             if not step_skipped:  # a skipped step's loss is non-finite; keep the mean clean
                 loss_running_sum = loss_running_sum + metrics["loss"]
                 loss_running_count += 1
 
             logging_step = global_step % log_interval == 0
-            if logging_step:
+            sync_step = logging_step or monitor.wants_step_metrics
+            if sync_step:
                 # syncing here puts the outstanding device work in the step bucket below,
                 # so window goodput stays honest without a per-step host sync
                 loss = float(metrics["loss"])
                 grad_norm = float(metrics["grad_norm"])
             step_seconds = time.perf_counter() - step_start
             telemetry.record_step(global_step, data_seconds, step_seconds)
+            # feeds the flight recorder + anomaly detectors BEFORE the nonfinite abort can
+            # fire, so a NaN-abort's flight record contains the offending step
+            monitor.observe_step(
+                global_step,
+                loss=loss if sync_step else None,
+                grad_norm=grad_norm if sync_step else None,
+                step_seconds=step_seconds,
+                data_seconds=data_seconds,
+                skipped=step_skipped,
+            )
+            if monitor.health_due(global_step) and "health" in metrics:
+                monitor.emit_health(global_step, metrics["health"])
+
+            if ft_args.skip_nonfinite_steps:
+                consecutive_nonfinite = handle_nonfinite_step(
+                    step_skipped,
+                    consecutive_nonfinite,
+                    global_step,
+                    ft_args.max_consecutive_nonfinite_steps,
+                )
 
             if logging_step:
                 track_train_metrics(
@@ -278,12 +305,19 @@ def train(
                 break
 
         finish_pending_checkpoint()  # commit an in-flight async save before exiting
+    except BaseException as error:
+        exit_status = f"error:{type(error).__name__}"
+        # crash path: preserve the last-N-steps flight record before unwinding (no-op if a
+        # fault-tolerance hook — stall watchdog, preemption — already dumped)
+        monitor.dump_flight_record(crash_reason(error), error=error)
+        raise
     finally:
         if ft_args.preemption_checkpointing:
             uninstall_preemption_handler()
+        unregister_crash_hook(monitor.dump_flight_record)
         if isinstance(batch_iter, StallWatchdog):
             batch_iter.close()
-        telemetry.close()
+        telemetry.close("preempted" if preempted else exit_status)
         uninstall_telemetry()
 
     # final eval only when the loop didn't just run one at this step (reference finetune.py

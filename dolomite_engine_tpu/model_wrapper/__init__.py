@@ -10,3 +10,10 @@ def log_model(model: ModelWrapper) -> None:
 
     log_rank_0(logging.INFO, f"model = {model.model}")
     log_rank_0(logging.INFO, f"num parameters = {model.num_parameters():,}")
+    groups = model.parameter_group_counts()
+    if len(groups) > 1:
+        log_rank_0(
+            logging.INFO,
+            "parameters by group = "
+            + ", ".join(f"{name}: {count:,}" for name, count in groups.items()),
+        )

@@ -401,3 +401,15 @@ class ModelWrapper:
         return sum(
             int(jnp.prod(jnp.asarray(x.shape))) for x in jax.tree.leaves(self.abstract_params())
         )
+
+    def parameter_group_counts(self) -> dict[str, int]:
+        """Per-top-level-group parameter counts — the same grouping the health monitor and
+        `model_report` record use (utils/diagnostics.py), from one abstract trace."""
+        import math
+
+        from ..utils.diagnostics import group_items
+
+        return {
+            name: sum(int(math.prod(leaf.shape)) for leaf in jax.tree.leaves(subtree))
+            for name, subtree in group_items(self.abstract_params())
+        }

@@ -80,9 +80,12 @@ def get_logical_axis_rules(
 def _ambient_mesh():
     """The mesh the surrounding program activated, under either JAX API: the new
     `jax.sharding.set_mesh` (abstract mesh) or the classic `with mesh:` resource env."""
-    m = jax.sharding.get_abstract_mesh()
-    if m is not None and not m.empty:
-        return m
+    # jax < 0.5 has no get_abstract_mesh; fall through to the classic resource env there
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract_mesh is not None:
+        m = get_abstract_mesh()
+        if m is not None and not m.empty:
+            return m
     try:  # classic context; private import keeps the deprecated public shim quiet
         from jax._src import mesh as _mesh_lib
 

@@ -49,17 +49,22 @@ from .utils import (
     ExperimentsTracker,
     ProgressBar,
     StallWatchdog,
+    build_health_monitor,
     build_telemetry,
+    crash_reason,
+    emit_model_report,
     init_distributed,
     install_preemption_handler,
     install_telemetry,
     log_rank_0,
     preemption_requested,
+    register_crash_hook,
     setup_tf32,
     step_annotation,
     trace_annotation,
     uninstall_preemption_handler,
     uninstall_telemetry,
+    unregister_crash_hook,
 )
 
 
@@ -179,6 +184,23 @@ def train(
         rngs = None if rng is None else {"dropout": rng}
         return model.loss(params, text, rngs=rngs, train=True, fp8_state=fp8_state)
 
+    # always-on telemetry (docs/OBSERVABILITY.md): goodput breakdown + MFU per logging
+    # window into the per-host JSONL sink, counters from the fault-tolerance/checkpoint
+    # layers, on-demand profiling. MFU needs the per-group analytic FLOPs and how many
+    # devices share one model-parallel group under SPMD. The health monitor rides the same
+    # sink: per-group tensor stats in the jitted step (when health.interval > 0), anomaly
+    # detection, crash flight recorder.
+    telemetry = build_telemetry(
+        args,
+        experiments_tracker,
+        model_tflops_per_step=step_tflops,
+        devices_per_group=max(jax.device_count() // dp_world_size, 1),
+    )
+    install_telemetry(telemetry)
+    monitor = build_health_monitor(args, telemetry)
+    register_crash_hook(monitor.dump_flight_record)
+    emit_model_report(telemetry, state, model_tflops_per_step=step_tflops)
+
     offload = _resolve_cpu_offload(args)
     jit_kwargs = _offload_jit_kwargs(state) if offload else {}
     train_step = jax.jit(
@@ -191,6 +213,7 @@ def train(
             gradient_clipping=args.training_parameters.gradient_clipping,
             offload_optimizer=offload,
             skip_nonfinite=ft_args.skip_nonfinite_steps,
+            collect_health=monitor.wants_step_metrics,
         ),
         donate_argnums=(0,),
         **jit_kwargs,
@@ -207,18 +230,6 @@ def train(
         jax_rng = jax.random.PRNGKey(args.random_args.seed)
 
     val_group_names = get_group_names(args, "val_weighted_split_paths")
-
-    # always-on telemetry (docs/OBSERVABILITY.md): goodput breakdown + MFU per logging
-    # window into the per-host JSONL sink, counters from the fault-tolerance/checkpoint
-    # layers, on-demand profiling. MFU needs the per-group analytic FLOPs and how many
-    # devices share one model-parallel group under SPMD.
-    telemetry = build_telemetry(
-        args,
-        experiments_tracker,
-        model_tflops_per_step=step_tflops,
-        devices_per_group=max(jax.device_count() // dp_world_size, 1),
-    )
-    install_telemetry(telemetry)
 
     if eval_during_training and starting_iteration == 0 and eval_steps:
         with telemetry.timer("eval"), trace_annotation("eval"):
@@ -253,6 +264,7 @@ def train(
     last_saved_step = None
     consecutive_nonfinite = 0
     preempted = False
+    exit_status = "ok"
     try:
         while global_step < num_training_steps:
             global_step += 1
@@ -277,25 +289,40 @@ def train(
             if ft_args.skip_nonfinite_steps:
                 # host sync per step — the price of counting consecutive skips promptly
                 step_skipped = bool(metrics["skipped"])
-                consecutive_nonfinite = handle_nonfinite_step(
-                    step_skipped,
-                    consecutive_nonfinite,
-                    global_step,
-                    ft_args.max_consecutive_nonfinite_steps,
-                )
 
             if not step_skipped:  # a skipped step's loss is non-finite; keep the mean clean
                 loss_running_sum = loss_running_sum + metrics["loss"]
                 loss_running_count += 1
 
             logging_step = global_step % log_interval == 0
-            if logging_step:
+            sync_step = logging_step or monitor.wants_step_metrics
+            if sync_step:
                 # syncing here puts the outstanding device work in the step bucket below,
                 # so window goodput stays honest without a per-step host sync
                 loss = float(metrics["loss"])
                 grad_norm = float(metrics["grad_norm"])
             step_seconds = time.perf_counter() - step_start
             telemetry.record_step(global_step, data_seconds, step_seconds)
+            # feeds the flight recorder + anomaly detectors BEFORE the nonfinite abort can
+            # fire, so a NaN-abort's flight record contains the offending step
+            monitor.observe_step(
+                global_step,
+                loss=loss if sync_step else None,
+                grad_norm=grad_norm if sync_step else None,
+                step_seconds=step_seconds,
+                data_seconds=data_seconds,
+                skipped=step_skipped,
+            )
+            if monitor.health_due(global_step) and "health" in metrics:
+                monitor.emit_health(global_step, metrics["health"])
+
+            if ft_args.skip_nonfinite_steps:
+                consecutive_nonfinite = handle_nonfinite_step(
+                    step_skipped,
+                    consecutive_nonfinite,
+                    global_step,
+                    ft_args.max_consecutive_nonfinite_steps,
+                )
 
             if logging_step:
                 step_time = data_seconds + step_seconds
@@ -379,12 +406,19 @@ def train(
                 break
 
         finish_pending_checkpoint()  # commit an in-flight async save before exiting
+    except BaseException as error:
+        exit_status = f"error:{type(error).__name__}"
+        # crash path: preserve the last-N-steps flight record before unwinding (no-op if a
+        # fault-tolerance hook — stall watchdog, preemption — already dumped)
+        monitor.dump_flight_record(crash_reason(error), error=error)
+        raise
     finally:
         if ft_args.preemption_checkpointing:
             uninstall_preemption_handler()
+        unregister_crash_hook(monitor.dump_flight_record)
         if isinstance(batch_iter, StallWatchdog):
             batch_iter.close()
-        telemetry.close()
+        telemetry.close("preempted" if preempted else exit_status)
         uninstall_telemetry()
 
     # final test-set evaluation (reference `pretrain.py:216` evaluates test loaders after

@@ -24,6 +24,7 @@ import optax
 from flax import struct
 
 from .utils import ExperimentsTracker, get_telemetry, log_rank_0
+from .utils.diagnostics import per_group_health
 
 
 class TrainState(struct.PyTreeNode):
@@ -81,6 +82,7 @@ def make_train_step(
     rng_per_step: bool = True,
     offload_optimizer: bool = False,
     skip_nonfinite: bool = False,
+    collect_health: bool = False,
 ):
     """Build the jitted train step.
 
@@ -96,6 +98,12 @@ def make_train_step(
     poisoning them with NaN updates; `metrics["skipped"]` reports it (0/1) so the loop can
     count consecutive skips and abort past a threshold. `step` still advances — a skipped
     step consumes its batch and keeps host/device step counters aligned.
+
+    `collect_health` (logging_args.telemetry.health.interval > 0): additionally return
+    `metrics["health"]` — per-top-level-group grad/param norms and update/param ratios
+    (`utils/diagnostics.per_group_health`), computed on device so the host only syncs them
+    at the health-record cadence. Off (default) the traced program is bit-identical to the
+    pre-health step.
     """
 
     def train_step(state: TrainState, batch, rng: jax.Array):
@@ -175,6 +183,8 @@ def make_train_step(
         metrics = {"loss": loss, "grad_norm": grad_norm}
         if step_ok is not None:
             metrics["skipped"] = (~step_ok).astype(jnp.int32)
+        if collect_health:
+            metrics["health"] = per_group_health(state.params, grads, new_params)
         return new_state, metrics
 
     return train_step

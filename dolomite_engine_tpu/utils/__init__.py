@@ -5,13 +5,27 @@ import os
 
 import jax
 
+from .diagnostics import (
+    EWMADetector,
+    FlightRecorder,
+    HealthMonitor,
+    StragglerDetector,
+    build_health_monitor,
+    build_model_report,
+    crash_reason,
+    emit_model_report,
+    per_group_health,
+)
 from .fault_tolerance import (
     StallWatchdog,
     install_preemption_handler,
     preemption_requested,
+    register_crash_hook,
     request_preemption,
     reset_preemption,
+    run_crash_hooks,
     uninstall_preemption_handler,
+    unregister_crash_hook,
 )
 from .logger import (
     get_logger,
@@ -41,6 +55,7 @@ from .telemetry import (
     detect_peak_tflops_per_device,
     get_telemetry,
     install_telemetry,
+    stable_config_hash,
     step_annotation,
     trace_annotation,
     uninstall_telemetry,

@@ -33,6 +33,33 @@ _PREVIOUS_HANDLERS: dict[int, Any] = {}
 
 _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
+# callbacks run when a fault-tolerance guard is about to end the run (preemption notice,
+# stall watchdog): the crash flight recorder (utils/diagnostics.py) registers its dump here
+# so the last-N-steps record is on disk while the process is still alive to write it
+_CRASH_HOOKS: list = []
+
+
+def register_crash_hook(hook) -> None:
+    """Register `hook(reason: str)` to run at the moment a fault-tolerance guard fires."""
+    if hook not in _CRASH_HOOKS:
+        _CRASH_HOOKS.append(hook)
+
+
+def unregister_crash_hook(hook) -> None:
+    try:
+        _CRASH_HOOKS.remove(hook)
+    except ValueError:
+        pass
+
+
+def run_crash_hooks(reason: str) -> None:
+    """Invoke every registered hook; a failing hook must never mask the original fault."""
+    for hook in list(_CRASH_HOOKS):
+        try:
+            hook(reason)
+        except Exception as error:
+            log_rank_0(logging.WARNING, f"crash hook failed ({reason}): {error!r}")
+
 
 def _handle_signal(signum: int, frame) -> None:
     _SIGNAL_COUNTS[signum] = _SIGNAL_COUNTS.get(signum, 0) + 1
@@ -41,8 +68,10 @@ def _handle_signal(signum: int, frame) -> None:
         raise KeyboardInterrupt
     if not _PREEMPTION.is_set():
         _PREEMPTION.set()
-        # the process is going away — write the event record now, not at the next window
+        # the process is going away — write the event record + flight record now, not at
+        # the next window (the grace period may not reach one)
         get_telemetry().count("preemptions", event=True)
+        run_crash_hooks("preemption")
         log_rank_0(
             logging.WARNING,
             f"received signal {signal.Signals(signum).name}: finishing the current step, "
@@ -148,6 +177,7 @@ class StallWatchdog:
         except queue.Empty:
             # the raise below usually kills the run — record the stall durably first
             get_telemetry().count("loader_stalls", event=True)
+            run_crash_hooks("loader_stall")
             raise RuntimeError(
                 f"{self.description} stalled: no batch within {self.timeout_seconds:.1f}s "
                 "wall-clock — hung storage mount or dead data worker; aborting so the run "

@@ -18,8 +18,13 @@ Sink schema (one JSON object per line; see docs/OBSERVABILITY.md):
      "goodput": {"compile","data","step","checkpoint","eval","other","goodput_pct"},
      "step_time": {"count","mean","min","max"}, "mfu_pct", "tflops_per_group",
      "counters": {...cumulative...}, "gauges": {...device memory, host rss...}}
-    {"kind": "event",  "ts", "rank", "event", "step", ...}   # nan_skip, loader_stall, ...
-    {"kind": "run_end","ts", "rank", "step", "counters"}
+    {"kind": "event",  "ts", "rank", "event", "step", ...}   # nan_skip, loader_stall, anomaly
+    {"kind": "health", "ts", "rank", "step", "stats"}        # per-group norms (diagnostics.py)
+    {"kind": "model_report", ...}                            # one-shot introspection (diagnostics.py)
+    {"kind": "run_end","ts", "rank", "step", "status", "counters"}
+
+The full kind -> required-field table is :data:`RECORD_SCHEMA`;
+`scripts/check_telemetry_schema.py` statically checks every call site against it.
 
 Cross-module counters (`utils/retry.py`, `utils/fault_tolerance.py`, `checkpointing.py`,
 `data/dataloader.py`) reach the active instance through :func:`get_telemetry`, a process-wide
@@ -35,10 +40,12 @@ scopes via :func:`trace_annotation`, so captured traces read as the goodput buck
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
 import signal
+import socket
 import threading
 import time
 from contextlib import contextmanager, nullcontext
@@ -49,6 +56,65 @@ import jax
 from .logger import log_rank_0
 
 SCHEMA_VERSION = 1
+
+# Declared record kinds -> required fields. scripts/check_telemetry_schema.py statically
+# validates every sink write in the package against this table (tier-1 test), so a new
+# record type cannot ship undeclared/undocumented. `ts` and `rank` are stamped by _emit on
+# every record and are not repeated here.
+RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
+    "run_start": (
+        "schema",
+        "devices",
+        "device_kind",
+        "peak_tflops_per_device",
+        "model_tflops_per_step",
+        "host",
+        "pid",
+        "jax_version",
+        "jaxlib_version",
+        "config_hash",
+    ),
+    "step": ("step", "t"),
+    "window": (
+        "step",
+        "window_seconds",
+        "goodput",
+        "step_time",
+        "mfu_pct",
+        "tflops_per_group",
+        "counters",
+        "gauges",
+    ),
+    "event": ("event",),
+    "run_end": ("step", "status", "counters"),
+    # training health subsystem (utils/diagnostics.py)
+    "health": ("step", "stats"),
+    "model_report": ("param_groups", "totals", "hbm"),
+}
+
+# every literal counter name used through the registry; `count(..., event=True)` names must
+# additionally appear in KNOWN_EVENTS (they write an event record under the same name)
+KNOWN_COUNTERS: tuple[str, ...] = (
+    "nan_skips",
+    "io_retries",
+    "io_failures",
+    "loader_stalls",
+    "preemptions",
+    "checkpoints_saved",
+    "checkpoints_pruned",
+    "loader_batches",
+    "profiles_captured",
+)
+
+KNOWN_EVENTS: tuple[str, ...] = (
+    "nan_skips",
+    "io_failures",
+    "loader_stalls",
+    "preemptions",
+    "profile_start",
+    "profiles_captured",
+    "anomaly",
+)
 
 # goodput buckets, in reporting order; "other" is the window remainder (python overhead,
 # logging, host syncs) and is derived, never accumulated directly
@@ -262,6 +328,7 @@ class Telemetry:
         devices_per_group: int = 1,
         profiler: OnDemandProfiler | None = None,
         rank: int | None = None,
+        config_hash: str | None = None,
     ) -> None:
         self.rank = jax.process_index() if rank is None else rank
         self.experiments_tracker = experiments_tracker
@@ -287,7 +354,15 @@ class Telemetry:
                 os.makedirs(sink_dir, exist_ok=True)
             self._file = open(sink_path, "a")
 
+        try:
+            import jaxlib
+
+            jaxlib_version = jaxlib.__version__
+        except Exception:
+            jaxlib_version = None
         device_kinds = sorted({d.device_kind for d in jax.local_devices()})
+        # host/pid/versions/config hash make runs attributable post-hoc: which machine,
+        # which software, which exact resolved config produced this sink
         self._emit(
             {
                 "kind": "run_start",
@@ -296,6 +371,11 @@ class Telemetry:
                 "device_kind": ", ".join(device_kinds),
                 "peak_tflops_per_device": peak_tflops_per_device,
                 "model_tflops_per_step": model_tflops_per_step,
+                "host": socket.gethostname(),
+                "pid": os.getpid(),
+                "jax_version": jax.__version__,
+                "jaxlib_version": jaxlib_version,
+                "config_hash": config_hash,
             }
         )
 
@@ -332,6 +412,16 @@ class Telemetry:
 
     def event(self, name: str, step: int | None = None, **fields) -> None:
         record = {"kind": "event", "event": name}
+        if step is not None:
+            record["step"] = step
+        record.update(fields)
+        self._emit(record)
+
+    def emit_record(self, kind: str, step: int | None = None, **fields) -> None:
+        """Write a record of an additional declared kind (``health``, ``model_report`` —
+        utils/diagnostics.py). The kind must be declared in :data:`RECORD_SCHEMA`;
+        scripts/check_telemetry_schema.py enforces that statically."""
+        record: dict[str, Any] = {"kind": kind}
         if step is not None:
             record["step"] = step
         record.update(fields)
@@ -449,10 +539,21 @@ class Telemetry:
 
     # ------------------------------------------------------------------ lifecycle
 
-    def close(self) -> None:
+    def close(self, status: str = "ok") -> None:
+        """End of run. `status` is how it ended — ``ok``, ``preempted``, or
+        ``error:<ExceptionType>`` — written into the run_end record so a reader can tell a
+        clean exit from a crash without parsing logs (the loops call this from a `finally`,
+        so the sink is flushed and statused on every exit path)."""
         if self.profiler is not None:
             self.profiler.close()
-        self._emit({"kind": "run_end", "step": self._last_step, "counters": dict(self.counters)})
+        self._emit(
+            {
+                "kind": "run_end",
+                "step": self._last_step,
+                "status": status,
+                "counters": dict(self.counters),
+            }
+        )
         with self._lock:
             if self._file is not None:
                 self._file.close()
@@ -477,6 +578,9 @@ class _NullTelemetry:
     def event(self, name, step=None, **fields) -> None:
         pass
 
+    def emit_record(self, kind, step=None, **fields) -> None:
+        pass
+
     def timer(self, bucket):
         return nullcontext()
 
@@ -492,7 +596,7 @@ class _NullTelemetry:
     def poll_profiler(self, step) -> None:
         pass
 
-    def close(self) -> None:
+    def close(self, status: str = "ok") -> None:
         pass
 
 
@@ -514,6 +618,17 @@ def uninstall_telemetry() -> None:
 def get_telemetry() -> Telemetry | _NullTelemetry:
     """The active instance, or a shared no-op when none is installed."""
     return _ACTIVE if _ACTIVE is not None else _NULL
+
+
+def stable_config_hash(args) -> str | None:
+    """Short stable hash of the fully-resolved config tree — two sinks with the same hash
+    ran the same config, whatever YAML/defaults produced it. None when the args tree can't
+    serialize (never fatal: the hash is attribution metadata)."""
+    try:
+        blob = json.dumps(args.to_dict(), sort_keys=True, default=str)
+    except Exception:
+        return None
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def build_telemetry(
@@ -572,4 +687,5 @@ def build_telemetry(
         peak_tflops_per_device=peak_override or detect_peak_tflops_per_device(),
         devices_per_group=devices_per_group,
         profiler=profiler,
+        config_hash=stable_config_hash(args),
     )

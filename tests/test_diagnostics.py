@@ -1,0 +1,651 @@
+"""Training health subsystem tests (ISSUE 3 tentpole): in-jit per-group stats, anomaly
+detector math (EWMA z-scores, straggler window), the crash flight recorder (ring buffer +
+dump-on-induced-NaN through the REAL finetune loop), the startup model_report, run_end exit
+status, run_start attribution fields, `tools/doctor.py`, and the static telemetry-schema
+checker.
+
+All CPU-only pytrees — no sharded-model paths (those are broken at seed, see memory)."""
+
+import importlib.util
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dolomite_engine_tpu import finetune
+from dolomite_engine_tpu.arguments import TrainingArgs
+from dolomite_engine_tpu.train_utils import TrainState, make_train_step, reset_profiler_schedule
+from dolomite_engine_tpu.utils import StallWatchdog
+from dolomite_engine_tpu.utils.diagnostics import (
+    EWMADetector,
+    FlightRecorder,
+    HealthMonitor,
+    StragglerDetector,
+    build_health_monitor,
+    build_model_report,
+    crash_reason,
+    per_group_health,
+)
+from dolomite_engine_tpu.utils.fault_tolerance import (
+    register_crash_hook,
+    run_crash_hooks,
+    unregister_crash_hook,
+)
+from dolomite_engine_tpu.utils.telemetry import Telemetry, uninstall_telemetry
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO_ROOT, "tools", f"{name}.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _read_sink(path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    uninstall_telemetry()
+    reset_profiler_schedule()
+    yield
+    uninstall_telemetry()
+    reset_profiler_schedule()
+
+
+# --------------------------------------------------------------------------- in-jit stats
+
+
+def test_per_group_health_values():
+    params = {"w": jnp.array([3.0, 4.0]), "b": jnp.array([0.0])}
+    grads = {"w": jnp.array([1.0, 0.0]), "b": jnp.array([2.0])}
+    new_params = {"w": jnp.array([3.0, 4.5]), "b": jnp.array([0.0])}
+    health = jax.jit(per_group_health)(params, grads, new_params)
+    assert set(health) == {"param_norm", "grad_norm", "update_ratio"}
+    assert set(health["grad_norm"]) == {"w", "b"}
+    assert float(health["param_norm"]["w"]) == pytest.approx(5.0)
+    assert float(health["grad_norm"]["b"]) == pytest.approx(2.0)
+    assert float(health["update_ratio"]["w"]) == pytest.approx(0.5 / 5.0)
+    assert float(health["update_ratio"]["b"]) == pytest.approx(0.0)
+
+
+def test_per_group_health_non_mapping_tree():
+    health = per_group_health(jnp.ones((2,)), jnp.ones((2,)), jnp.ones((2,)))
+    assert list(health["grad_norm"]) == ["params"]
+
+
+def test_train_step_health_gating():
+    """collect_health=False (health.interval=0) must not add anything to the step outputs;
+    collect_health=True returns the per-group pytree grouped by top-level key."""
+    params = {"w": jnp.ones((4,), jnp.float32), "b": jnp.zeros((2,), jnp.float32)}
+    optimizer = optax.sgd(1e-2)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
+    )
+    batch = {"x": jnp.ones((1, 2, 4), jnp.float32)}
+
+    def loss_fn(params, micro, rng):
+        return jnp.mean(params["w"] * micro["x"]) + jnp.sum(params["b"]) * 0.0
+
+    step_off = make_train_step(loss_fn, optimizer)
+    _, metrics_off = jax.jit(step_off)(state, batch, jax.random.PRNGKey(0))
+    assert set(metrics_off) == {"loss", "grad_norm"}
+
+    step_on = make_train_step(loss_fn, optimizer, collect_health=True)
+    new_state, metrics_on = jax.jit(step_on)(state, batch, jax.random.PRNGKey(0))
+    health = metrics_on["health"]
+    assert set(health["grad_norm"]) == {"w", "b"}
+    # update ratio reflects the actual parameter delta
+    expected = float(
+        jnp.linalg.norm(new_state.params["w"] - params["w"]) / jnp.linalg.norm(params["w"])
+    )
+    assert float(health["update_ratio"]["w"]) == pytest.approx(expected, rel=1e-5)
+
+
+# --------------------------------------------------------------------------- detector math
+
+
+def test_ewma_detector_flags_spike_after_warmup():
+    detector = EWMADetector(alpha=0.1, threshold=4.0, warmup=5)
+    values = [1.0, 1.1, 0.9, 1.05, 0.95, 1.0, 1.02]
+    for v in values:
+        z, flagged = detector.update("loss", v)
+        assert not flagged
+    z, flagged = detector.update("loss", 100.0)
+    assert flagged and z is not None and z > 4.0
+    # the spike folded in; a return to baseline scores negative but finite
+    z, flagged = detector.update("loss", 1.0)
+    assert z is not None and z < 0
+
+
+def test_ewma_detector_warmup_suppresses_flags():
+    detector = EWMADetector(alpha=0.1, threshold=1.0, warmup=10)
+    for v in (1.0, 50.0, 1.0, 50.0):  # wild swings inside warmup never flag
+        _, flagged = detector.update("loss", v)
+        assert not flagged
+
+
+def test_ewma_detector_nonfinite_always_flags_and_is_not_folded():
+    detector = EWMADetector(alpha=0.1, threshold=6.0, warmup=2)
+    detector.update("loss", 1.0)
+    detector.update("loss", 1.0)
+    z, flagged = detector.update("loss", float("nan"))
+    assert flagged and z is None
+    # the NaN did not poison the moments: a normal sample still scores finitely
+    z, flagged = detector.update("loss", 1.0)
+    assert not flagged
+
+
+def test_ewma_detector_constant_signal_then_jump():
+    detector = EWMADetector(alpha=0.1, threshold=6.0, warmup=3)
+    for _ in range(5):
+        _, flagged = detector.update("grad_norm", 2.0)
+        assert not flagged
+    _, flagged = detector.update("grad_norm", 2.5)  # any jump off a constant flags
+    assert flagged
+
+
+def test_straggler_detector_window():
+    detector = StragglerDetector(window=20, factor=2.0, min_samples=5)
+    for _ in range(5):
+        ratio, flagged = detector.update(0.1)
+        assert not flagged  # below min_samples, then exactly at median
+    ratio, flagged = detector.update(0.5)
+    assert flagged and ratio == pytest.approx(5.0)
+    ratio, flagged = detector.update(0.11)
+    assert not flagged
+
+
+def test_straggler_detector_persistent_regression_self_heals():
+    detector = StragglerDetector(window=6, factor=2.0, min_samples=3)
+    for _ in range(6):
+        detector.update(0.1)
+    flags = [detector.update(0.5)[1] for _ in range(10)]
+    assert flags[0] is True  # the regression fires...
+    assert flags[-1] is False  # ...and stops once the median catches up
+
+
+# --------------------------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_buffer_and_dump(tmp_path):
+    path = str(tmp_path / "telemetry" / "flight-record-rank-00000.json")
+    recorder = FlightRecorder(capacity=4, path=path, rank=0)
+    for step in range(1, 11):
+        recorder.record(step, loss=float(step), skipped=None)
+    assert [r["step"] for r in recorder.records] == [7, 8, 9, 10]
+    assert "skipped" not in recorder.records[0]  # None fields are dropped
+
+    assert recorder.dump("nan_abort", error=RuntimeError("boom")) == path
+    payload = json.load(open(path))
+    assert payload["reason"] == "nan_abort"
+    assert "RuntimeError" in payload["error"]
+    assert [r["step"] for r in payload["records"]] == [7, 8, 9, 10]
+    env = payload["environment"]
+    assert env["pid"] == os.getpid()
+    assert env["jax_version"] == jax.__version__
+    assert "hostname" in env and "device_count" in env
+
+    # first dump wins: a later, less specific dump must not overwrite it
+    recorder.record(99)
+    assert recorder.dump("exception:ValueError") == path
+    assert json.load(open(path))["reason"] == "nan_abort"
+
+
+def test_flight_recorder_pathless_is_noop():
+    recorder = FlightRecorder(capacity=2, path=None)
+    recorder.record(1)
+    assert recorder.dump("whatever") is None
+
+
+def test_crash_reason_classification():
+    assert crash_reason(RuntimeError("aborting: 3 consecutive non-finite steps")) == "nan_abort"
+    assert crash_reason(RuntimeError("dataloader stalled: no batch within 5s")) == "loader_stall"
+    assert crash_reason(RuntimeError("aborting: 3 consecutive anomalous steps")) == "anomaly_abort"
+    assert crash_reason(ValueError("nope")) == "exception:ValueError"
+
+
+def test_crash_hooks_run_and_never_mask(tmp_path):
+    calls = []
+
+    def good(reason):
+        calls.append(reason)
+
+    def bad(reason):
+        raise RuntimeError("hook bug")
+
+    register_crash_hook(bad)
+    register_crash_hook(good)
+    try:
+        run_crash_hooks("loader_stall")  # the failing hook must not stop the good one
+    finally:
+        unregister_crash_hook(bad)
+        unregister_crash_hook(good)
+    assert calls == ["loader_stall"]
+
+
+def test_stall_watchdog_triggers_crash_hooks():
+    import threading
+
+    dumped = []
+    register_crash_hook(lambda reason: dumped.append(reason))
+    release = threading.Event()
+
+    def hung():
+        yield 1
+        release.wait(30)
+
+    watchdog = StallWatchdog(hung(), timeout_seconds=0.2)
+    try:
+        assert next(watchdog) == 1
+        with pytest.raises(RuntimeError, match="stalled"):
+            next(watchdog)
+    finally:
+        release.set()
+        watchdog.close()
+        unregister_crash_hook(dumped.append)
+    assert dumped == ["loader_stall"]
+
+
+# --------------------------------------------------------------------------- monitor
+
+
+def test_monitor_anomaly_events_and_consecutive_abort(tmp_path):
+    sink = tmp_path / "t.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    recorder = FlightRecorder(capacity=8, path=str(tmp_path / "fr.json"))
+    monitor = HealthMonitor(
+        telemetry,
+        interval=1,
+        ewma_alpha=0.1,
+        zscore_threshold=4.0,
+        warmup_steps=3,
+        abort_after_consecutive_anomalies=3,
+        flight_recorder=recorder,
+    )
+    step = 0
+    for _ in range(8):
+        step += 1
+        assert monitor.observe_step(step, loss=1.0, step_seconds=0.01) == []
+    # one z-score spike, then non-finite losses: three consecutive flags -> abort
+    with pytest.raises(RuntimeError, match="consecutive anomalous"):
+        for value in (500.0, float("nan"), float("nan")):
+            step += 1
+            monitor.observe_step(step, loss=value, step_seconds=0.01)
+    # abort dumped the flight record with the flagged steps inside
+    payload = json.load(open(tmp_path / "fr.json"))
+    assert payload["reason"] == "anomaly_abort"
+    flagged = [r for r in payload["records"] if "anomalies" in r]
+    assert len(flagged) >= 3 and all("loss" in r["anomalies"] for r in flagged)
+    events = [r for r in _read_sink(sink) if r["kind"] == "event" and r["event"] == "anomaly"]
+    assert len(events) >= 3 and all(e["signal"] == "loss" for e in events)
+    telemetry.close()
+
+
+def test_monitor_emit_health_record_and_tracker_fanout(tmp_path):
+    tracked = []
+
+    class _Tracker:
+        def track(self, values, step=None, context=None):
+            tracked.append((values, step, context))
+
+    sink = tmp_path / "t.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), experiments_tracker=_Tracker(), rank=0)
+    monitor = HealthMonitor(telemetry, interval=2)
+    assert not monitor.health_due(1) and monitor.health_due(2)
+    health_tree = {
+        "grad_norm": {"w": jnp.asarray(0.5)},
+        "param_norm": {"w": jnp.asarray(2.0)},
+        "update_ratio": {"w": jnp.asarray(0.25)},
+    }
+    stats = monitor.emit_health(2, health_tree)
+    assert stats["grad_norm"]["w"] == 0.5
+    records = [r for r in _read_sink(sink) if r["kind"] == "health"]
+    assert records[0]["step"] == 2 and records[0]["stats"]["param_norm"]["w"] == 2.0
+    assert tracked == [
+        (
+            {
+                "health/grad_norm/w": 0.5,
+                "health/param_norm/w": 2.0,
+                "health/update_ratio/w": 0.25,
+            },
+            2,
+            "health",
+        )
+    ]
+    telemetry.close()
+
+
+def test_monitor_defaults_are_inert():
+    telemetry = Telemetry(sink_path=None, rank=0)
+    monitor = HealthMonitor(telemetry)
+    assert not monitor.wants_step_metrics and not monitor.health_due(100)
+    assert monitor.observe_step(1, step_seconds=0.01) == []
+    assert monitor.dump_flight_record("whatever") is None
+    telemetry.close()
+
+
+# --------------------------------------------------------------------------- model report
+
+
+def test_build_model_report_groups_and_hbm():
+    params = {
+        "transformer": {"w": jnp.ones((4, 8), jnp.float32)},
+        "lm_head": {"w": jnp.ones((8,), jnp.bfloat16)},
+    }
+    opt_state = (jnp.ones((4, 8), jnp.float32), jnp.ones((4, 8), jnp.float32))
+    report = build_model_report(params, opt_state=opt_state, model_tflops_per_step=1.5)
+    assert set(report["param_groups"]) == {"transformer", "lm_head"}
+    assert report["param_groups"]["transformer"]["parameters"] == 32
+    assert report["param_groups"]["transformer"]["bytes"] == 32 * 4
+    assert report["param_groups"]["lm_head"]["bytes"] == 8 * 2
+    assert report["totals"]["parameters"] == 40
+    assert report["totals"]["optimizer_bytes"] == 2 * 32 * 4
+    assert report["hbm"]["state_bytes_per_device"] == (
+        report["totals"]["param_bytes"] + report["totals"]["optimizer_bytes"]
+    )
+    assert report["model_tflops_per_step"] == 1.5
+
+
+def test_build_model_report_abstract_tree():
+    """Doctor path: ShapeDtypeStructs without shardings summarize at full size."""
+    params = {"g": jax.ShapeDtypeStruct((16, 2), jnp.float32)}
+    report = build_model_report(params)
+    assert report["param_groups"]["g"]["bytes_per_device"] == 16 * 2 * 4
+    assert report["param_groups"]["g"]["shardings"] == []
+
+
+# --------------------------------------------------------------------------- real loop
+
+
+class _Model:
+    def loss(self, params, batch, rngs=None, train=True, fp8_state=None):
+        return jnp.mean(params["w"] * batch["x"]) + jnp.sum(params["b"]) * 0.0
+
+
+class _Loader:
+    def __init__(self, nan_steps=(), n=4):
+        self.nan_steps = set(nan_steps)
+        self.n = n
+        self.count = 0
+
+    def __iter__(self):
+        for _ in range(self.n):
+            value = np.nan if self.count in self.nan_steps else 1.0
+            self.count += 1
+            yield {"x": np.full((2, 4), value, np.float32)}
+
+    def state_dict(self):
+        return {"count": self.count}
+
+    def load_state_dict(self, sd):
+        self.count = sd["count"]
+
+
+def _train_args(tmp_path, num_steps=6, health=None, **ft_kwargs):
+    telemetry = {"health": health} if health is not None else {}
+    cfg = dict(
+        model_args=dict(
+            model_class="AutoModelForCausalLM",
+            pretrained_config=dict(
+                model_type="gpt_dolomite", vocab_size=8, n_positions=8, n_embd=4,
+                n_layer=1, n_head=1,
+            ),
+        ),
+        tuning_args=dict(tuning_method="full_finetuning"),
+        training_parameters=dict(
+            num_training_steps=num_steps,
+            micro_batch_size=2,
+            gradient_accumulation_steps=1,
+            eval_during_training=False,
+        ),
+        datasets=[dict(class_name="DebugDataset", data_name="debug", class_args={})],
+        save_args=dict(save_path=str(tmp_path / "ckpt"), save_interval=100),
+        logging_args=dict(log_interval=2, telemetry=telemetry),
+        random_args=dict(seed=3),
+    )
+    if ft_kwargs:
+        cfg["fault_tolerance_args"] = ft_kwargs
+    return TrainingArgs(**cfg)
+
+
+def _run_loop(args, loader=None):
+    params = {"w": jnp.ones((4,), jnp.float32), "b": jnp.zeros((2,), jnp.float32)}
+    optimizer = optax.adam(1e-2)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=optimizer.init(params)
+    )
+    finetune.train(
+        args, _Model(), state, optimizer, lambda step: 1e-2, loader or _Loader(), None,
+        experiments_tracker=None,
+    )
+
+
+def test_loop_emits_model_report_run_start_attribution_and_ok_status(tmp_path):
+    _run_loop(_train_args(tmp_path))
+    records = _read_sink(tmp_path / "ckpt" / "telemetry" / "rank-00000.jsonl")
+
+    run_start = records[0]
+    assert run_start["pid"] == os.getpid()
+    assert run_start["jax_version"] == jax.__version__
+    assert isinstance(run_start["host"], str) and run_start["host"]
+    assert isinstance(run_start["config_hash"], str) and len(run_start["config_hash"]) == 16
+
+    reports = [r for r in records if r["kind"] == "model_report"]
+    assert len(reports) == 1
+    assert set(reports[0]["param_groups"]) == {"b", "w"}
+    assert reports[0]["totals"]["parameters"] == 6
+
+    run_end = records[-1]
+    assert run_end["kind"] == "run_end" and run_end["status"] == "ok"
+    # default health.interval=0: no health records, no per-step stats in the jitted step
+    assert not any(r["kind"] == "health" for r in records)
+
+
+def test_config_hash_stable_and_config_sensitive(tmp_path):
+    from dolomite_engine_tpu.utils import stable_config_hash
+
+    a = _train_args(tmp_path)
+    b = _train_args(tmp_path)
+    c = _train_args(tmp_path, num_steps=7)
+    assert stable_config_hash(a) == stable_config_hash(b)
+    assert stable_config_hash(a) != stable_config_hash(c)
+
+
+def test_induced_nan_abort_dumps_flight_record_with_offending_step(tmp_path):
+    """Acceptance: with health on, an induced-NaN abort produces schema-valid health
+    records, a run_end error status, and a flight-record dump containing the offending
+    steps."""
+    args = _train_args(
+        tmp_path,
+        num_steps=8,
+        health=dict(interval=2, flight_recorder_steps=8),
+        skip_nonfinite_steps=True,
+        max_consecutive_nonfinite_steps=2,
+    )
+    with pytest.raises(RuntimeError, match="non-finite"):
+        _run_loop(args, loader=_Loader(nan_steps=(4, 5, 6), n=8))
+
+    records = _read_sink(tmp_path / "ckpt" / "telemetry" / "rank-00000.jsonl")
+    assert records[-1]["status"] == "error:RuntimeError"
+
+    healths = [r for r in records if r["kind"] == "health"]
+    assert healths and all(
+        set(h["stats"]) == {"grad_norm", "param_norm", "update_ratio"} for h in healths
+    )
+    assert set(healths[0]["stats"]["grad_norm"]) == {"b", "w"}
+
+    anomalies = [r for r in records if r["kind"] == "event" and r["event"] == "anomaly"]
+    assert [a["step"] for a in anomalies if a["signal"] == "nonfinite_step"] == [5, 6]
+
+    dump = json.load(
+        open(tmp_path / "ckpt" / "telemetry" / "flight-record-rank-00000.json")
+    )
+    assert dump["reason"] == "nan_abort"
+    offending = [r for r in dump["records"] if r.get("skipped")]
+    assert [r["step"] for r in offending] == [5, 6]
+    assert all(math.isnan(r["loss"]) for r in offending)  # per-step sync captured the NaN
+
+
+def test_loop_health_records_at_interval_cadence(tmp_path):
+    args = _train_args(tmp_path, num_steps=6, health=dict(interval=3))
+    _run_loop(args)
+    records = _read_sink(tmp_path / "ckpt" / "telemetry" / "rank-00000.jsonl")
+    healths = [r for r in records if r["kind"] == "health"]
+    assert [h["step"] for h in healths] == [3, 6]
+    for h in healths:
+        assert all(
+            isinstance(v, float) for groups in h["stats"].values() for v in groups.values()
+        )
+
+
+# --------------------------------------------------------------------------- tools
+
+
+def test_summary_tool_renders_health_anomaly_model_report_and_truncation(tmp_path, capsys):
+    args = _train_args(
+        tmp_path,
+        num_steps=8,
+        health=dict(interval=2, flight_recorder_steps=8),
+        skip_nonfinite_steps=True,
+        max_consecutive_nonfinite_steps=2,
+    )
+    with pytest.raises(RuntimeError):
+        _run_loop(args, loader=_Loader(nan_steps=(4, 5, 6), n=8))
+
+    # tear the last line the way a SIGKILL would (no trailing newline, half a record)
+    sink = tmp_path / "ckpt" / "telemetry" / "rank-00000.jsonl"
+    with open(sink, "a") as f:
+        f.write('{"kind": "step", "step": 99, "t": {"da')
+
+    tool = _load_tool("telemetry_summary")
+    assert tool.main([str(tmp_path / "ckpt")]) == 0
+    captured = capsys.readouterr()
+    assert "model:" in captured.out and "parameter group" in captured.out
+    assert "health @ step" in captured.out
+    assert "anomalies:" in captured.out and "nonfinite_step" in captured.out
+    assert "status = error:RuntimeError" in captured.out
+    assert "flight-record-rank-00000.json" in captured.out
+    assert "1 malformed line(s) skipped" in captured.err
+
+
+def test_doctor_smoke_on_config(tmp_path, capsys):
+    config_path = tmp_path / "doctor.yml"
+    config_path.write_text(
+        """
+model_args:
+  model_class: AutoModelForCausalLM
+  pretrained_config:
+    model_type: gpt_dolomite
+    vocab_size: 64
+    n_positions: 32
+    n_embd: 16
+    n_layer: 2
+    n_head: 2
+tuning_args:
+  tuning_method: pretraining
+training_parameters:
+  num_training_steps: 10
+  micro_batch_size: 2
+  eval_during_training: false
+datasets:
+  - class_name: MegatronDataset
+    data_name: doc
+    class_args:
+      sequence_length: 16
+save_args:
+  save_path: {save}
+  save_interval: 5
+""".format(save=tmp_path / "run")
+    )
+    doctor = _load_tool("doctor")
+    assert doctor.main(["--config", str(config_path)]) == 0
+    out = capsys.readouterr().out
+    assert "config OK" in out and "model OK" in out
+    assert "model_report" in out and "parameter group" in out
+    assert "transformer" in out
+    assert "tokens/step (dp world" in out  # device count varies with the test env
+
+
+def test_doctor_rejects_bad_config(tmp_path, capsys):
+    config_path = tmp_path / "bad.yml"
+    config_path.write_text("model_args:\n  model_class: NoSuchClass\n")
+    doctor = _load_tool("doctor")
+    assert doctor.main(["--config", str(config_path)]) == 1
+    assert "CONFIG ERROR" in capsys.readouterr().err
+
+
+def test_telemetry_schema_checker_passes_on_package():
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(_REPO_ROOT, "scripts", "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    assert checker.check_package() == []
+
+
+def test_telemetry_schema_checker_catches_drift(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(_REPO_ROOT, "scripts", "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'get_telemetry().count("made_up_counter")\n'
+        'telemetry.event("mystery_event", step=1)\n'
+        'telemetry.emit_record("undeclared_kind", foo=1)\n'
+    )
+    errors = checker.check_package(str(tmp_path))
+    assert any("made_up_counter" in e for e in errors)
+    assert any("mystery_event" in e for e in errors)
+    assert any("undeclared_kind" in e for e in errors)
+
+
+def test_health_args_validation():
+    with pytest.raises(Exception):
+        TrainingArgs(
+            model_args=dict(
+                model_class="AutoModelForCausalLM",
+                pretrained_config=dict(
+                    model_type="gpt_dolomite", vocab_size=8, n_positions=8, n_embd=4,
+                    n_layer=1, n_head=1,
+                ),
+            ),
+            tuning_args=dict(tuning_method="full_finetuning"),
+            training_parameters=dict(
+                num_training_steps=5, micro_batch_size=2, eval_during_training=False
+            ),
+            datasets=[dict(class_name="DebugDataset", data_name="debug", class_args={})],
+            save_args=dict(save_path="/tmp/x", save_interval=1),
+            logging_args=dict(telemetry=dict(health=dict(interval=-1))),
+        )
+
+
+def test_build_health_monitor_derives_flight_record_path(tmp_path):
+    telemetry = Telemetry(sink_path=None, rank=0)
+    args = _train_args(tmp_path, health=dict(interval=5, flight_recorder_steps=16))
+    monitor = build_health_monitor(args, telemetry)
+    assert monitor.interval == 5 and monitor.wants_step_metrics
+    assert monitor.flight_recorder.path == str(
+        tmp_path / "ckpt" / "telemetry" / f"flight-record-rank-{jax.process_index():05d}.json"
+    )
+    assert monitor.flight_recorder.records.maxlen == 16
+
+    # flight_recorder_steps=0 disables the recorder
+    args_off = _train_args(tmp_path, health=dict(flight_recorder_steps=0))
+    assert build_health_monitor(args_off, telemetry).flight_recorder is None
+    telemetry.close()

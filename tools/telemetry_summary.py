@@ -6,7 +6,9 @@ Accepts one or more sink files, or directories (a run's save_path or its `teleme
 subdir — every `*.jsonl` underneath is read and merged, so multi-host runs summarize in one
 call). Output is paste-ready for PROFILE.md / bench reports: step-time percentiles
 (steady-state, first-step compile excluded), the goodput breakdown as a % of wall-clock,
-MFU, and cumulative counter totals.
+MFU, cumulative counter totals, plus the training-health records — run exit status, the
+`model_report` introspection (param groups/bytes/sharding/HBM), the latest per-group
+`health` stats, anomaly events, and pointers to any crash flight records in the run dir.
 
 Schema: docs/OBSERVABILITY.md (`dolomite_engine_tpu/utils/telemetry.py` writes it).
 Malformed lines — the one line a SIGKILL may tear — are counted and skipped, never fatal.
@@ -47,7 +49,9 @@ def read_records(files: list[str]) -> tuple[list[dict], int]:
     records: list[dict] = []
     bad_lines = 0
     for path in files:
-        with open(path) as f:
+        # errors="replace": a crash can tear the last line mid-multibyte-character; the
+        # mangled line must count as bad, not raise UnicodeDecodeError for the whole sink
+        with open(path, errors="replace") as f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -72,12 +76,79 @@ def percentile(sorted_values: list[float], q: float) -> float:
     return sorted_values[min(rank, len(sorted_values) - 1)]
 
 
+def _format_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.4g} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.4g} TiB"
+
+
+def format_model_report(report: dict) -> list[str]:
+    """Markdown rendering of one `model_report` record (shared with tools/doctor.py)."""
+    lines: list[str] = []
+    totals = report.get("totals") or {}
+    hbm = report.get("hbm") or {}
+    lines.append(
+        f"model: {totals.get('parameters', 0):,} parameters, "
+        f"{_format_bytes(totals.get('param_bytes', 0))} params + "
+        f"{_format_bytes(totals.get('optimizer_bytes', 0))} optimizer state"
+        + (
+            f" + {_format_bytes(totals['fp8_bytes'])} fp8 state"
+            if totals.get("fp8_bytes")
+            else ""
+        )
+    )
+    mesh = report.get("mesh")
+    device_line = f"devices: {report.get('devices', '?')} [{report.get('device_kind', '?')}]"
+    if mesh:
+        device_line += f", mesh {dict(zip(mesh['axis_names'], mesh['shape']))}"
+    lines.append(device_line)
+    state_per_device = hbm.get("state_bytes_per_device")
+    if state_per_device is not None:
+        memory_line = f"state per device: {_format_bytes(state_per_device)}"
+        if hbm.get("bytes_limit"):
+            memory_line += (
+                f" of {_format_bytes(hbm['bytes_limit'])} detected HBM "
+                f"({100.0 * hbm.get('state_fraction_of_limit', 0):.1f}%)"
+            )
+            if hbm.get("state_fraction_of_limit", 0) > 0.9:
+                memory_line += " — **WARNING: little or no headroom for activations**"
+        else:
+            memory_line += " (device capacity not detected)"
+        lines.append(memory_line)
+    if report.get("model_tflops_per_step"):
+        lines.append(f"analytic model TFLOPs/step/group: {report['model_tflops_per_step']:.4g}")
+    cost = report.get("cost_analysis")
+    if cost:
+        lines.append(
+            "compiled-step cost analysis: "
+            + ", ".join(f"{k} = {v:.4g}" for k, v in sorted(cost.items()))
+        )
+    groups = report.get("param_groups") or {}
+    if groups:
+        lines.append("")
+        lines.append("| parameter group | params | bytes | bytes/device | sharding |")
+        lines.append("|---|---|---|---|---|")
+        for name in sorted(groups):
+            g = groups[name]
+            shardings = ", ".join(g.get("shardings") or []) or "-"
+            lines.append(
+                f"| {name} | {g.get('parameters', 0):,} | {_format_bytes(g.get('bytes', 0))} "
+                f"| {_format_bytes(g.get('bytes_per_device', 0))} | {shardings} |"
+            )
+    return lines
+
+
 def summarize(records: list[dict]) -> str:
     steps = [r for r in records if r.get("kind") == "step"]
     windows = [r for r in records if r.get("kind") == "window"]
     events = [r for r in records if r.get("kind") == "event"]
     run_starts = [r for r in records if r.get("kind") == "run_start"]
     run_ends = [r for r in records if r.get("kind") == "run_end"]
+    healths = [r for r in records if r.get("kind") == "health"]
+    model_reports = [r for r in records if r.get("kind") == "model_report"]
 
     lines: list[str] = []
 
@@ -88,6 +159,23 @@ def summarize(records: list[dict]) -> str:
             f"peak {first.get('peak_tflops_per_device') or 'n/a'} TFLOPs/device, "
             f"model {first.get('model_tflops_per_step') or 'n/a'} TFLOPs/step"
         )
+        if first.get("host") or first.get("config_hash"):
+            lines.append(
+                f"host {first.get('host', '?')} pid {first.get('pid', '?')}, "
+                f"jax {first.get('jax_version', '?')}/{first.get('jaxlib_version', '?')}, "
+                f"config {first.get('config_hash') or 'n/a'}"
+            )
+        lines.append("")
+
+    if run_ends:
+        statuses = sorted({str(r.get("status", "unknown")) for r in run_ends})
+        last_step = max((r.get("step") or 0) for r in run_ends)
+        lines.append(f"run end: status = {', '.join(statuses)} @ step {last_step}")
+        lines.append("")
+
+    # ---------------------------------------------------------------- model report
+    if model_reports:
+        lines.extend(format_model_report(model_reports[0]))
         lines.append("")
 
     # ---------------------------------------------------------------- step times
@@ -138,6 +226,43 @@ def summarize(records: list[dict]) -> str:
         lines.append("**" + ", ".join(summary) + "**")
         lines.append("")
 
+    # ---------------------------------------------------------------- health / anomalies
+    if healths:
+        last = healths[-1]  # the latest per-group snapshot is what a triage wants first
+        stats = last.get("stats") or {}
+        metric_names = [m for m in ("grad_norm", "param_norm", "update_ratio") if m in stats]
+        group_names = sorted({g for metric in stats.values() for g in metric})
+        if metric_names and group_names:
+            lines.append(
+                f"| health @ step {last.get('step', '?')} | " + " | ".join(metric_names) + " |"
+            )
+            lines.append("|---|" + "---|" * len(metric_names))
+            for group in group_names:
+                cells = []
+                for metric in metric_names:
+                    value = stats[metric].get(group)
+                    cells.append(f"{value:.4g}" if isinstance(value, (int, float)) else "-")
+                lines.append(f"| {group} | " + " | ".join(cells) + " |")
+            lines.append(f"({len(healths)} health record(s))")
+            lines.append("")
+
+    anomalies = [e for e in events if e.get("event") == "anomaly"]
+    if anomalies:
+        by_signal: dict[str, list] = {}
+        for anomaly in anomalies:
+            by_signal.setdefault(str(anomaly.get("signal", "?")), []).append(
+                anomaly.get("step")
+            )
+        parts = []
+        for signal_name in sorted(by_signal):
+            flagged_steps = [s for s in by_signal[signal_name] if s is not None]
+            span = (
+                f" (steps {min(flagged_steps)}-{max(flagged_steps)})" if flagged_steps else ""
+            )
+            parts.append(f"{signal_name} x{len(by_signal[signal_name])}{span}")
+        lines.append("anomalies: " + ", ".join(parts))
+        lines.append("")
+
     # ---------------------------------------------------------------- counters
     # last-window/run_end counters are cumulative; merge max-per-name across ranks
     counters: dict[str, int] = {}
@@ -160,7 +285,7 @@ def summarize(records: list[dict]) -> str:
         )
         lines.append("")
 
-    if not (steps or windows or events or run_starts):
+    if not (steps or windows or events or run_starts or healths or model_reports):
         lines.append("(no telemetry records found)")
     return "\n".join(lines).rstrip() + "\n"
 
@@ -179,6 +304,18 @@ def main(argv: list[str] | None = None) -> int:
     records, bad_lines = read_records(files)
     print(f"telemetry summary over {len(files)} sink(s), {len(records)} records\n")
     print(summarize(records))
+    flight_records = sorted(
+        path
+        for arg in parsed.paths
+        if os.path.isdir(arg)
+        for path in glob.glob(
+            os.path.join(arg, "**", "flight-record-*.json"), recursive=True
+        )
+    )
+    if flight_records:
+        print("flight record(s) found — a run died here:")
+        for path in flight_records:
+            print(f"  {path}")
     if bad_lines:
         print(f"({bad_lines} malformed line(s) skipped)", file=sys.stderr)
     return 0
