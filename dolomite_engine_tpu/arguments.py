@@ -657,11 +657,21 @@ class GenerationParameters(BaseArgs):
     top_k: int | None = None
     # top p
     top_p: float | None = None
+    # prompt width bucket for static-shape compilation: prompts are padded to the next
+    # multiple so the jitted prefill/decode compiles once per bucket instead of once per
+    # batch (generate.py, serving/engine.py). Must be a positive multiple of 8 (TPU lane
+    # alignment; 64 keeps compile counts low for typical prompt spreads).
+    prompt_bucket_multiple: int = 64
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(
             [(self.batch_size, "batch_size"), (self.max_new_tokens, "max_new_tokens")]
         )
+        if self.prompt_bucket_multiple <= 0 or self.prompt_bucket_multiple % 8 != 0:
+            raise ValueError(
+                f"prompt_bucket_multiple must be a positive multiple of 8, got "
+                f"{self.prompt_bucket_multiple}"
+            )
 
 
 class InferenceArgs(BaseArgs):

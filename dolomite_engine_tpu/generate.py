@@ -26,7 +26,13 @@ from .utils import ProgressBar, log_rank_0, set_logger
 
 
 def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode) -> None:
-    """Main generation loop (reference `generate.py:14-67`)."""
+    """Main generation loop (reference `generate.py:14-67`).
+
+    Decoder-only models run through the continuous-batching serving engine
+    (`_generate_with_engine`): requests are admitted into KV slots as they free up, so a
+    short row never waits for the batch's slowest. Encoder-decoder models keep the
+    legacy fixed-batch chunked loop (the slot pool is a decoder self-attention cache).
+    """
     batch_size = args.generation_parameters.batch_size
 
     os.makedirs(args.output_dir, exist_ok=True)
@@ -34,9 +40,14 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
 
     generate_kwargs = args.generation_parameters.to_dict()
     generate_kwargs.pop("batch_size", None)
+    generate_kwargs.pop("prompt_bucket_multiple", None)
 
     progress_bar = ProgressBar(0, sum(len(dataset) for dataset in datasets_list))
     rng = jax.random.PRNGKey(args.random_args.seed or 0)
+
+    if not model.is_encoder_decoder:
+        _generate_with_engine(args, model, params, datasets_list, progress_bar, rng)
+        return
 
     for dataset in datasets_list:
         output_path = os.path.join(args.output_dir, f"output-{dataset.data_name}.jsonl")
@@ -58,7 +69,10 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
                     # bucket instead of once per batch
                     real_rows = len(batch)
                     collated = _pad_to_static_shapes(
-                        collated, batch_size, model.eos_token_id, width_multiple=64
+                        collated,
+                        batch_size,
+                        model.eos_token_id,
+                        width_multiple=args.generation_parameters.prompt_bucket_multiple,
                     )
                     rng, step_rng = jax.random.split(rng)
                     texts, counts = model.generate(params, collated, generate_kwargs, step_rng)
@@ -74,6 +88,81 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
                         )
                     progress_bar.update(len(batch))
                     batch = []
+        log_rank_0(20, f"wrote {output_path}")
+
+
+def _generate_with_engine(
+    args: InferenceArgs, model, params, datasets_list: list, progress_bar, rng
+) -> None:
+    """Decoder-only batch decode via the continuous-batching engine (serving/engine.py):
+    every dataset row is one request with its own prompt length; slots are reused the
+    moment a row hits EOS instead of idling until the chunk's slowest row finishes. One
+    engine (one compiled decode step) serves all datasets; outputs keep dataset order."""
+    from .serving import SamplingParams, ServingEngine, serve_batch
+
+    gp = args.generation_parameters
+    multiple = gp.prompt_bucket_multiple
+    max_prompt = max(
+        (len(dataset[i]["input"]) for dataset in datasets_list for i in range(len(dataset))),
+        default=0,
+    )
+    if max_prompt == 0:
+        for dataset in datasets_list:
+            output_path = os.path.join(args.output_dir, f"output-{dataset.data_name}.jsonl")
+            open(output_path, "w").close()
+            log_rank_0(20, f"wrote {output_path}")
+        return
+    max_len = -(-max_prompt // multiple) * multiple + gp.max_new_tokens
+
+    sampling = SamplingParams(
+        do_sample=bool(gp.do_sample),
+        temperature=gp.temperature,
+        top_k=gp.top_k,
+        top_p=gp.top_p,
+    )
+    pad_token_id = next(
+        (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
+    )
+    engine = ServingEngine(
+        model.model,
+        params,
+        num_slots=gp.batch_size,
+        max_len=max_len,
+        prefill_bucket_multiple=multiple,
+        max_waiting=max(2 * gp.batch_size, 8),
+        eos_token_id=model.eos_token_id,
+        pad_token_id=pad_token_id,
+    )
+
+    for dataset in datasets_list:
+        specs = []
+        for index in range(len(dataset)):
+            rng, request_rng = jax.random.split(rng)
+            specs.append(
+                dict(
+                    prompt_ids=dataset[index]["input"],
+                    max_new_tokens=gp.max_new_tokens,
+                    sampling=sampling,
+                    rng=request_rng,
+                    on_finish=lambda state: progress_bar.update(1),
+                )
+            )
+        states = serve_batch(engine, specs)
+
+        output_path = os.path.join(args.output_dir, f"output-{dataset.data_name}.jsonl")
+        with open(output_path, "w") as output_file:
+            for state in states:
+                output_file.write(
+                    json.dumps(
+                        {
+                            DatasetKeys.generated_text.value: model.tokenizer.decode(
+                                state.tokens, skip_special_tokens=True
+                            ),
+                            DatasetKeys.num_generated_tokens.value: state.num_generated,
+                        }
+                    )
+                    + "\n"
+                )
         log_rank_0(20, f"wrote {output_path}")
 
 

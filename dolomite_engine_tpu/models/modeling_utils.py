@@ -224,14 +224,24 @@ def update_kv_cache(
     attention_mask: jax.Array | None,
 ):
     """Write new K/V at cache_index and return the full-cache views plus a mask that hides
-    not-yet-written slots. Returns (key, value, kv_cache, attention_mask, query_offset)."""
-    seq = key.shape[1]
-    k_cache = jax.lax.dynamic_update_slice(kv_cache["k"], key, (0, cache_index, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(kv_cache["v"], value, (0, cache_index, 0, 0))
-    kv_cache = {"k": k_cache, "v": v_cache}
+    not-yet-written slots. Returns (key, value, kv_cache, attention_mask, query_offset).
 
-    cache_len = k_cache.shape[1]
-    valid = jnp.arange(cache_len)[None, :] < (cache_index + seq)
+    `cache_index` is normally a scalar shared by the whole batch. A per-row [B] vector is
+    the continuous-batching decode case (serving/engine.py): every slot writes its single
+    new token at its own length, so the validity frontier is per-row too."""
+    seq = key.shape[1]
+    if getattr(cache_index, "ndim", 0) == 1:
+        if seq != 1:
+            raise NotImplementedError("per-row cache_index supports single-token decode only")
+        rows = jnp.arange(key.shape[0])
+        k_cache = kv_cache["k"].at[rows, cache_index].set(key[:, 0])
+        v_cache = kv_cache["v"].at[rows, cache_index].set(value[:, 0])
+        valid = jnp.arange(k_cache.shape[1])[None, :] < (cache_index[:, None] + seq)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(kv_cache["k"], key, (0, cache_index, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(kv_cache["v"], value, (0, cache_index, 0, 0))
+        valid = jnp.arange(k_cache.shape[1])[None, :] < (cache_index + seq)
+    kv_cache = {"k": k_cache, "v": v_cache}
     attention_mask = (
         valid.astype(jnp.int32)
         if attention_mask is None

@@ -36,13 +36,21 @@ def make_attention_mask(
     segment_ids_kv: jax.Array | None = None,
     query_offset: jax.Array | int = 0,
 ) -> jax.Array | None:
-    """Boolean [B, 1, Sq, Skv] mask (True = attend), or None when fully visible."""
+    """Boolean [B, 1, Sq, Skv] mask (True = attend), or None when fully visible.
+
+    `query_offset` may be a per-row [B] vector (continuous-batching decode: every slot
+    continues at its own cache position), producing a per-row causal frontier."""
     mask = None
 
     if causal:
-        q_pos = jnp.arange(query_length)[:, None] + query_offset
-        k_pos = jnp.arange(key_length)[None, :]
-        mask = (k_pos <= q_pos)[None, None]
+        if getattr(query_offset, "ndim", 0) == 1:
+            q_pos = jnp.arange(query_length)[None, :, None] + query_offset[:, None, None]
+            k_pos = jnp.arange(key_length)[None, None, :]
+            mask = (k_pos <= q_pos)[:, None]  # [B, 1, Sq, Skv]
+        else:
+            q_pos = jnp.arange(query_length)[:, None] + query_offset
+            k_pos = jnp.arange(key_length)[None, :]
+            mask = (k_pos <= q_pos)[None, None]
 
     if attention_mask is not None:
         pad = attention_mask.astype(bool)[:, None, None, :]  # [B, 1, 1, Skv]

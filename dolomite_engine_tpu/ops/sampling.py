@@ -30,9 +30,10 @@ def apply_top_p(logits: jax.Array, top_p: float) -> jax.Array:
     """Nucleus filtering (HF TopPLogitsWarper): keep the smallest set of tokens whose
     cumulative probability exceeds top_p; the highest-probability token always survives."""
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
     # keep tokens while the cumulative mass BEFORE them is < top_p
-    keep_sorted = (cumulative - jax.nn.softmax(sorted_logits, axis=-1)) < top_p
+    keep_sorted = (cumulative - probs) < top_p
     keep_sorted = keep_sorted.at[..., 0].set(True)
     # threshold = smallest kept logit
     threshold = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
@@ -58,3 +59,74 @@ def sample_token(
     if top_p is not None and top_p < 1.0:
         logits = apply_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+# None-param encodings for the vectorized path: each is the value under which the
+# corresponding processor is bitwise inert (x/1.0 == x; k >= vocab keeps every rank;
+# p >= 1.0 forces the keep mask fully on), so a row with "no processing" reproduces
+# `sample_token`'s skipped-processor branches exactly.
+NO_TEMPERATURE = 1.0
+NO_TOP_K = 0
+NO_TOP_P = 1.0
+
+
+def sample_tokens_vectorized(
+    logits: jax.Array,
+    rngs: jax.Array,
+    do_sample: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-row sampling with traced [S]-shaped params — the continuous-batching decode
+    step, where every slot carries its own request's sampling settings, so one compiled
+    program serves every request mix (serving/engine.py).
+
+    Row `s` reproduces ``sample_token(logits[s:s+1], rngs[s], **row_params)`` bit-for-bit:
+    disabled processors use the inert encodings above (`NO_TEMPERATURE`/`NO_TOP_K`/
+    `NO_TOP_P`) rather than being skipped, and the per-row key drives the same
+    `jax.random.categorical` a single-request call would.
+
+    Args: logits [S, V]; rngs [S]-stacked PRNG keys; do_sample [S] bool;
+    temperature/top_p [S] float; top_k [S] int. Returns [S] int32.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    vocab = logits.shape[-1]
+    x = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # top-k as a per-row rank threshold: kth_best = k-th largest (== lax.top_k's last kept)
+    k_eff = jnp.where((top_k <= 0) | (top_k >= vocab), vocab, top_k)
+    sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
+    kth_best = jnp.take_along_axis(sorted_x, (k_eff - 1).astype(jnp.int32)[:, None], axis=-1)
+    x = jnp.where(x < kth_best, _NEG_INF, x)
+
+    # top-p over the top-k-filtered logits (same processor order as sample_token)
+    sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_x, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    keep = (cumulative - probs) < top_p[:, None]
+    keep = keep.at[..., 0].set(True)
+    keep = keep | (top_p >= 1.0)[:, None]
+    threshold = jnp.min(jnp.where(keep, sorted_x, jnp.inf), axis=-1, keepdims=True)
+    x = jnp.where(x < threshold, _NEG_INF, x)
+
+    sampled = jax.vmap(jax.random.categorical)(rngs, x).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)
+
+
+def encode_sampling_params(
+    do_sample: bool,
+    temperature: float | None,
+    top_k: int | None,
+    top_p: float | None,
+) -> tuple[bool, float, int, float]:
+    """Map one request's optional python sampling params to the dense per-slot encoding
+    `sample_tokens_vectorized` consumes: (do_sample, temperature, top_k, top_p)."""
+    return (
+        bool(do_sample),
+        NO_TEMPERATURE if temperature is None else float(temperature),
+        NO_TOP_K if top_k is None else int(top_k),
+        NO_TOP_P if top_p is None else float(top_p),
+    )

@@ -1,0 +1,16 @@
+from .engine import EngineStats, ServingEngine, serve_batch
+from .kv_cache import SlotKVCachePool
+from .scheduler import QueueFullError, Request, RequestState, RequestStatus, SamplingParams, Scheduler
+
+__all__ = [
+    "EngineStats",
+    "QueueFullError",
+    "Request",
+    "RequestState",
+    "RequestStatus",
+    "SamplingParams",
+    "Scheduler",
+    "ServingEngine",
+    "SlotKVCachePool",
+    "serve_batch",
+]

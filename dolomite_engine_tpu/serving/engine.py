@@ -1,0 +1,466 @@
+"""Continuous-batching serving engine: one jitted decode step over a slot pool.
+
+The legacy path (`generation_utils.generate_tokens`) is one-shot: a batch arrives
+together, shares one set of python-static sampling params, and stalls until its slowest
+row finishes. This engine is the Orca/vLLM-style fix with fully static shapes:
+
+- **prefill** runs per request through length-bucketed jitted functions (right-padded to
+  the bucket so the prompt occupies cache positions ``[0, len)``), writes K/V into the
+  request's slot of a :class:`~dolomite_engine_tpu.serving.kv_cache.SlotKVCachePool`, and
+  samples the first token (that's TTFT);
+- **decode** is a single jitted step over the whole ``[num_slots]`` batch — per-slot
+  cache positions (vector ``cache_index``), per-slot RNG streams, and per-slot
+  **traced** sampling params (`ops/sampling.sample_tokens_vectorized`), so one compiled
+  program serves any mix of greedy/temperature/top-k/top-p requests and compiles exactly
+  once for the lifetime of the engine;
+- the **scheduler** admits waiting requests into freed slots at every step boundary
+  (FCFS, bounded queue, wall-clock deadlines) — a finished row's slot is reused next
+  step instead of stalling the batch.
+
+Tokens stream out through per-request callbacks the moment the host sees them (one
+device->host sync per step — the price of streaming and EOS detection, identical to the
+legacy path's end-of-call fetch amortized over steps).
+
+Numerics: a request decoded through the engine reproduces an equivalent single-request
+`generate_tokens` call token-for-token (same per-step RNG split discipline, same
+processor encodings; see tests/test_serving.py for the bit-exact parity suite).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.sampling import sample_tokens_vectorized
+from ..utils.telemetry import get_telemetry
+from .kv_cache import SlotKVCachePool
+from .scheduler import (
+    Request,
+    RequestState,
+    RequestStatus,
+    SamplingParams,
+    Scheduler,
+)
+
+_DEFAULT = object()  # "use the engine default" sentinel for per-request eos overrides
+
+
+@dataclass
+class EngineStats:
+    """Cumulative host-side accounting: rates for telemetry records and the bench harness.
+
+    `prefill_seconds`/`decode_seconds` are wall time inside the respective jitted calls
+    (including the host fetch that forces completion); token counts are prompt tokens
+    prefilled and tokens emitted by decode steps. The first token of each request is
+    sampled inside prefill — it shows up in `ttft_s` samples, not in either rate.
+    Cumulative over the engine's lifetime, like the telemetry window counters.
+    """
+
+    prefill_seconds: float = 0.0
+    decode_seconds: float = 0.0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    decode_steps: int = 0
+    ttft_s: list[float] = field(default_factory=list)
+    admitted: int = 0
+    completed: int = 0
+    rejected: int = 0
+    cancelled: int = 0
+
+    def prefill_tok_s(self) -> float | None:
+        if self.prefill_seconds <= 0:
+            return None
+        return self.prefill_tokens / self.prefill_seconds
+
+    def decode_tok_s(self) -> float | None:
+        if self.decode_seconds <= 0:
+            return None
+        return self.decode_tokens / self.decode_seconds
+
+    def mean_ttft_s(self) -> float | None:
+        if not self.ttft_s:
+            return None
+        return sum(self.ttft_s) / len(self.ttft_s)
+
+
+class ServingEngine:
+    """Drive a decoder-only dolomite model as a continuously-batched token service.
+
+    Args:
+        model: the flax module (unrolled, standard attention KV caches — not scan_layers,
+            not the RNN hybrid's recurrent caches).
+        params: parameter pytree (bare ``params`` tree or full variables dict).
+        num_slots: decode batch width == max concurrent requests.
+        max_len: per-slot cache length; every request needs
+            ``len(prompt) + max_new_tokens <= max_len``.
+        prefill_bucket_multiple: prompts are right-padded to the next multiple for the
+            bucketed prefill jit (one compile per distinct bucket).
+        max_waiting: waiting-queue bound; `submit` raises
+            :class:`~dolomite_engine_tpu.serving.scheduler.QueueFullError` beyond it.
+        eos_token_id / pad_token_id: engine defaults (per-request eos override on submit).
+        record_interval: emit a ``serving`` telemetry record every N decode steps
+            (0 = only on :meth:`drain`).
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        *,
+        num_slots: int,
+        max_len: int,
+        prefill_bucket_multiple: int = 64,
+        max_waiting: int = 128,
+        eos_token_id: int | None = None,
+        pad_token_id: int = 0,
+        cache_dtype=None,
+        rng: jax.Array | None = None,
+        record_interval: int = 0,
+        clock=time.monotonic,
+    ) -> None:
+        if prefill_bucket_multiple <= 0 or prefill_bucket_multiple % 8 != 0:
+            raise ValueError(
+                f"prefill_bucket_multiple must be a positive multiple of 8, got "
+                f"{prefill_bucket_multiple}"
+            )
+        config = getattr(model, "config", None)
+        n_positions = getattr(config, "n_positions", None)
+        if n_positions is not None and max_len > n_positions:
+            raise ValueError(f"max_len={max_len} exceeds model n_positions={n_positions}")
+
+        self.model = model
+        self._variables = {"params": params} if "params" not in params else params
+        self.default_eos = eos_token_id
+        self.pad_token_id = pad_token_id
+        self.cache_dtype = cache_dtype
+        self.prefill_bucket_multiple = prefill_bucket_multiple
+        self.record_interval = record_interval
+
+        self.pool = SlotKVCachePool(model, num_slots, max_len, cache_dtype)
+        self.scheduler = Scheduler(max_waiting=max_waiting, clock=clock)
+        self.stats = EngineStats()
+        self._step_count = 0
+        self._last_record_step = 0
+        self._base_rng = jax.random.PRNGKey(0) if rng is None else rng
+
+        num = self.pool.num_slots
+        # dense per-slot state, host-resident (mutated at admission/finish, shipped to the
+        # decode jit each step; shapes are static so no recompiles)
+        self._tokens = np.zeros(num, np.int32)
+        self._rngs = np.array(jax.random.split(jax.random.PRNGKey(0), num))
+        self._do_sample = np.zeros(num, bool)
+        self._temperature = np.ones(num, np.float32)
+        self._top_k = np.zeros(num, np.int32)
+        self._top_p = np.ones(num, np.float32)
+        self._slot_states: dict[int, RequestState] = {}
+
+        self._prefill_fns: dict[int, Any] = {}
+        # donate the cache pool: decode rewrites it in place instead of copying
+        # [layers, num_slots, max_len] of K/V every step
+        self._decode_step = jax.jit(self._decode_impl, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------ jitted programs
+
+    def _decode_impl(self, variables, caches, tokens, lengths, rngs, do_sample, temperature, top_k, top_p):
+        out = self.model.apply(
+            variables,
+            tokens[:, None],
+            position_ids=lengths[:, None],
+            kv_caches=caches,
+            cache_index=lengths,
+        )
+        logits = out.logits[:, -1]
+        split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]: row 0 carries, row 1 samples
+        next_tokens = sample_tokens_vectorized(
+            logits, split[:, 1], do_sample, temperature, top_k, top_p
+        )
+        return out.kv_caches, next_tokens, split[:, 0]
+
+    def _get_prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+
+            def prefill(variables, ids, mask, length, rng, do_sample, temperature, top_k, top_p):
+                # right-padded prompt: token i sits at cache position i, so the slot's
+                # validity frontier is just its length — no per-slot pad offsets
+                position_ids = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                caches = self.model.init_kv_caches(1, bucket, self.cache_dtype)
+                out = self.model.apply(
+                    variables,
+                    ids,
+                    position_ids=position_ids,
+                    attention_mask=mask,
+                    kv_caches=caches,
+                    cache_index=0,  # static 0: keeps the prefill fast path
+                )
+                last = jax.lax.dynamic_slice_in_dim(out.logits, length - 1, 1, axis=1)[:, 0]
+                carry, step_rng = jax.random.split(rng)
+                token = sample_tokens_vectorized(
+                    last,
+                    step_rng[None],
+                    do_sample[None],
+                    temperature[None],
+                    top_k[None],
+                    top_p[None],
+                )
+                return token[0], carry, out.kv_caches
+
+            fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        return fn
+
+    # ------------------------------------------------------------------ submission
+
+    def submit(
+        self,
+        prompt_ids: list[int],
+        max_new_tokens: int,
+        sampling: SamplingParams | None = None,
+        eos_token_id: int | None = _DEFAULT,
+        deadline_s: float | None = None,
+        on_token=None,
+        on_finish=None,
+        rng: jax.Array | None = None,
+    ) -> RequestState:
+        """Enqueue a request (FCFS). Raises QueueFullError at the queue bound and
+        ValueError when the request cannot fit a slot."""
+        prompt_ids = list(map(int, prompt_ids))
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if max_new_tokens <= 0:
+            raise ValueError(f"max_new_tokens must be positive, got {max_new_tokens}")
+        if len(prompt_ids) + max_new_tokens > self.pool.max_len:
+            raise ValueError(
+                f"request needs {len(prompt_ids)} prompt + {max_new_tokens} new tokens "
+                f"> max_len={self.pool.max_len}"
+            )
+        if rng is None:
+            self._base_rng, rng = jax.random.split(self._base_rng)
+        request = Request(
+            prompt_ids=prompt_ids,
+            max_new_tokens=int(max_new_tokens),
+            sampling=sampling or SamplingParams(),
+            eos_token_id=self.default_eos if eos_token_id is _DEFAULT else eos_token_id,
+            rng=rng,
+            deadline_s=deadline_s,
+            on_token=on_token,
+            on_finish=on_finish,
+        )
+        try:
+            state = self.scheduler.submit(request)
+        except Exception:
+            self.stats.rejected += 1
+            get_telemetry().count("serving_requests_rejected")
+            raise
+        return state
+
+    # ------------------------------------------------------------------ engine loop
+
+    def has_work(self) -> bool:
+        return bool(self._slot_states) or self.scheduler.queue_depth > 0
+
+    def step(self) -> bool:
+        """One scheduler iteration: reap deadline-expired slots, admit waiting requests
+        into free slots (prefill), run one decode step over the slot batch. Returns
+        whether any work remains."""
+        self._cancel_expired_running()
+        self._admit()
+        if self._slot_states:
+            self._decode_once()
+        if (
+            self.record_interval
+            and self._step_count - self._last_record_step >= self.record_interval
+        ):
+            self.emit_serving_record()
+        return self.has_work()
+
+    def drain(self) -> None:
+        """Run until every submitted request finished; emit a final serving record."""
+        while self.step():
+            pass
+        self.emit_serving_record()
+
+    @property
+    def decode_compiles(self) -> int:
+        """Number of compiled decode-step variants (the static-shape invariant: 1)."""
+        return int(self._decode_step._cache_size())
+
+    # ------------------------------------------------------------------ internals
+
+    def _admit(self) -> None:
+        admit, dead = self.scheduler.admissible(self.pool.num_free)
+        for state in dead:
+            self._finish(state, RequestStatus.cancelled)
+        for state in admit:
+            self._prefill_into_slot(state)
+
+    def _prefill_into_slot(self, state: RequestState) -> None:
+        request = state.request
+        slot = self.pool.allocate()
+        assert slot is not None, "scheduler admitted beyond the free-slot count"
+        prompt_len = len(request.prompt_ids)
+        multiple = self.prefill_bucket_multiple
+        bucket = min(-(-prompt_len // multiple) * multiple, self.pool.max_len)
+
+        ids = np.full((1, bucket), self.pad_token_id, np.int32)
+        ids[0, :prompt_len] = request.prompt_ids
+        mask = np.zeros((1, bucket), np.int32)
+        mask[0, :prompt_len] = 1
+
+        do_sample, temperature, top_k, top_p = request.sampling.encoded()
+        t0 = time.perf_counter()
+        token, carry, prefill_caches = self._get_prefill_fn(bucket)(
+            self._variables,
+            jnp.asarray(ids),
+            jnp.asarray(mask),
+            jnp.asarray(prompt_len, jnp.int32),
+            request.rng,
+            jnp.asarray(do_sample),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32),
+        )
+        self.pool.write_prefill(slot, prefill_caches, prompt_len)
+        first_token = int(token)  # host fetch: forces completion, ends the TTFT clock
+        self.stats.prefill_seconds += time.perf_counter() - t0
+        self.stats.prefill_tokens += prompt_len
+        self.stats.admitted += 1
+        get_telemetry().count("serving_requests_admitted")
+        get_telemetry().count("serving_prefill_tokens", prompt_len)
+
+        state.slot = slot
+        state.status = RequestStatus.running
+        state.first_token_t = self.scheduler.clock()
+        if state.ttft_s is not None:
+            self.stats.ttft_s.append(state.ttft_s)
+        self._slot_states[slot] = state
+        self._tokens[slot] = first_token
+        self._rngs[slot] = np.array(carry)
+        self._do_sample[slot] = do_sample
+        self._temperature[slot] = temperature
+        self._top_k[slot] = top_k
+        self._top_p[slot] = top_p
+
+        self._deliver(state, first_token)
+
+    def _decode_once(self) -> None:
+        t0 = time.perf_counter()
+        active = list(self._slot_states.keys())
+        caches, next_tokens, new_rngs = self._decode_step(
+            self._variables,
+            self.pool.caches,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self.pool.lengths),
+            jnp.asarray(self._rngs),
+            jnp.asarray(self._do_sample),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+        )
+        self.pool.caches = caches
+        tokens = np.asarray(next_tokens)  # host fetch: the streaming sync point
+        self._rngs = np.array(new_rngs)  # copy: slots mutate their key at admission
+        self._step_count += 1
+        self.stats.decode_steps += 1
+        self.stats.decode_seconds += time.perf_counter() - t0
+
+        emitted = 0
+        for slot in active:
+            state = self._slot_states.get(slot)
+            if state is None:
+                continue
+            # the token fed this step is now in the cache; the slot's frontier advances
+            self.pool.lengths[slot] += 1
+            token = int(tokens[slot])
+            self._tokens[slot] = token
+            emitted += 1
+            self._deliver(state, token)
+        self.stats.decode_tokens += emitted
+        if emitted:
+            get_telemetry().count("serving_decode_tokens", emitted)
+
+    def _deliver(self, state: RequestState, token: int) -> None:
+        """Stream one token and apply the per-request termination rules (EOS counts as an
+        emitted token, matching `generation_utils._trim_after_eos` semantics)."""
+        state.tokens.append(token)
+        if state.request.on_token is not None:
+            state.request.on_token(token)
+        eos = state.request.eos_token_id
+        if (eos is not None and token == eos) or (
+            state.num_generated >= state.request.max_new_tokens
+        ):
+            self._finish(state, RequestStatus.completed)
+
+    def _cancel_expired_running(self) -> None:
+        for state in [s for s in self._slot_states.values() if self.scheduler.expired(s)]:
+            self._finish(state, RequestStatus.cancelled)
+
+    def _finish(self, state: RequestState, status: RequestStatus) -> None:
+        state.status = status
+        state.finish_t = self.scheduler.clock()
+        if state.slot is not None:
+            self.pool.free(state.slot)
+            del self._slot_states[state.slot]
+        if status == RequestStatus.completed:
+            self.stats.completed += 1
+            get_telemetry().count("serving_requests_completed")
+        else:
+            self.stats.cancelled += 1
+            get_telemetry().count("serving_requests_cancelled")
+        if state.request.on_finish is not None:
+            state.request.on_finish(state)
+
+    # ------------------------------------------------------------------ telemetry
+
+    def emit_serving_record(self) -> None:
+        """Write one ``serving`` telemetry record — instantaneous queue/slot state plus
+        cumulative rates and counters (no-op sink when no telemetry is installed)."""
+        telemetry = get_telemetry()
+        stats = self.stats
+        self._last_record_step = self._step_count
+        telemetry.gauge("serving/queue_depth", self.scheduler.queue_depth)
+        telemetry.gauge("serving/slot_occupancy", self.pool.occupancy)
+        ttft = stats.mean_ttft_s()
+        prefill_rate = stats.prefill_tok_s()
+        decode_rate = stats.decode_tok_s()
+        telemetry.emit_record(
+            "serving",
+            step=self._step_count,
+            queue_depth=self.scheduler.queue_depth,
+            slots_active=self.pool.num_active,
+            num_slots=self.pool.num_slots,
+            ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
+            prefill_tok_s=None if prefill_rate is None else round(prefill_rate, 1),
+            decode_tok_s=None if decode_rate is None else round(decode_rate, 1),
+            counters={
+                "admitted": stats.admitted,
+                "completed": stats.completed,
+                "rejected": stats.rejected,
+                "cancelled": stats.cancelled,
+                "prefill_tokens": stats.prefill_tokens,
+                "decode_tokens": stats.decode_tokens,
+                "decode_steps": stats.decode_steps,
+            },
+        )
+
+
+def serve_batch(engine: ServingEngine, request_specs: list[dict]) -> list[RequestState]:
+    """Offline driver: feed every spec through the engine with queue backpressure and
+    drain. Results come back in submission order regardless of completion order — this is
+    what `generate.py` delegates to instead of its stall-on-slowest chunked loop."""
+    from .scheduler import QueueFullError
+
+    states: list[RequestState] = []
+    i = 0
+    while i < len(request_specs):
+        try:
+            states.append(engine.submit(**request_specs[i]))
+            i += 1
+        except QueueFullError:
+            engine.step()  # make room: decode progresses, slots free, queue drains
+    engine.drain()
+    return states

@@ -1,0 +1,107 @@
+"""Slot-based KV cache pool: static-shape cache memory for continuous batching.
+
+One preallocated ``[num_slots, max_len, kv_heads, head_dim]`` cache per layer (the same
+layout `model.init_kv_caches` produces for a fixed batch), plus host-side slot
+bookkeeping: a free list, per-slot length tracking, and reclamation on finish. The decode
+program only ever sees the full ``[num_slots, ...]`` arrays, so its shapes never change —
+requests come and go by overwriting slot rows, never by reshaping (the TPU-native
+equivalent of vLLM's block tables: one block per request, sized for the longest
+admissible sequence, traded against PagedAttention's fragmentation wins for a program
+that compiles exactly once).
+
+Slot hygiene relies on masking, not zeroing: a freed slot keeps its stale K/V, and the
+next occupant's prefill overwrites ``[0, bucket)`` while the per-row validity frontier
+(``update_kv_cache``'s `arange < length + 1` mask) hides everything it hasn't written.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+KVCacheList = list[Any]  # per-layer {"k": [S, L, H, D], "v": ...} (models/modeling_utils)
+
+
+class SlotKVCachePool:
+    """Fixed pool of `num_slots` cache rows of `max_len` tokens each.
+
+    The device arrays live in `self.caches` (a per-layer list, threaded through the
+    jitted decode step and reassigned from its output); allocation state lives on host.
+    """
+
+    def __init__(self, model: Any, num_slots: int, max_len: int, dtype=None) -> None:
+        assert num_slots > 0 and max_len > 0, (num_slots, max_len)
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.caches: KVCacheList = model.init_kv_caches(num_slots, max_len, dtype)
+        # pop() from the tail; reversed so slot 0 is handed out first (deterministic tests)
+        self._free: list[int] = list(reversed(range(num_slots)))
+        self._in_use: set[int] = set()
+        # number of valid cache entries per slot (prompt + generated-and-written tokens);
+        # 0 for free slots, so an idle slot's decode row masks down to its own garbage token
+        self.lengths = np.zeros(num_slots, np.int32)
+        self._insert_fn = None
+
+    # ------------------------------------------------------------------ allocation
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._in_use)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._in_use) / self.num_slots
+
+    def allocate(self) -> int | None:
+        """Claim a free slot (lowest index first), or None when the pool is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Reclaim a slot on request finish. The K/V rows are left stale (masked by
+        length 0) and reused by the next occupant's prefill."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._in_use.remove(slot)
+        self._free.append(slot)
+        self.lengths[slot] = 0
+
+    # ------------------------------------------------------------------ prefill insert
+
+    def write_prefill(self, slot: int, prefill_caches: KVCacheList, length: int) -> None:
+        """Copy a batch=1 prefill cache (``[1, bucket, H, D]`` per layer) into `slot` at
+        positions ``[0, bucket)`` and set the slot's length to the REAL prompt length.
+
+        The pad tail ``[length, bucket)`` lands in the pool too but stays outside the
+        validity frontier; decode overwrites it one token at a time.
+        """
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        assert 0 < length <= self.max_len, (length, self.max_len)
+        if self._insert_fn is None:
+            # jitted once per prefill bucket width (the update operand's static shape);
+            # the slot index itself is traced, so slots don't multiply compilations
+            self._insert_fn = jax.jit(_insert_slot)
+        self.caches = self._insert_fn(self.caches, prefill_caches, slot)
+        self.lengths[slot] = length
+
+
+def _insert_slot(pool_caches: KVCacheList, prefill_caches: KVCacheList, slot) -> KVCacheList:
+    out = []
+    for pool, new in zip(pool_caches, prefill_caches):
+        out.append(
+            {
+                "k": jax.lax.dynamic_update_slice(pool["k"], new["k"], (slot, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(pool["v"], new["v"], (slot, 0, 0, 0)),
+            }
+        )
+    return out

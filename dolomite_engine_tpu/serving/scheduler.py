@@ -1,0 +1,138 @@
+"""Request queue + continuous-batching scheduler (Orca-style iteration-level scheduling).
+
+Requests enter a bounded FCFS waiting queue (`submit`); at every engine step boundary the
+scheduler admits as many waiting requests as there are free slots (`admissible`), runs
+each through a length-bucketed prefill (the engine owns the jitted functions), and hands
+the slot to the shared decode step. Deadlines are wall-clock: a request that exceeds its
+budget is rejected while waiting or cancelled mid-decode, freeing its slot for the queue.
+
+This module is pure host-side bookkeeping — no jax. Shapes and compiled programs are the
+engine's problem; the scheduler only decides *which* request occupies *which* slot *when*.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..ops.sampling import encode_sampling_params
+
+
+class QueueFullError(RuntimeError):
+    """Raised by submit when the waiting queue is at its bound (admission control —
+    callers shed load or retry; the engine never buffers unboundedly)."""
+
+
+class RequestStatus(str, enum.Enum):
+    waiting = "waiting"
+    running = "running"
+    completed = "completed"
+    cancelled = "cancelled"  # deadline exceeded (waiting or mid-decode)
+
+    def __str__(self) -> str:  # plain value in logs/records
+        return self.value
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling settings (the per-slot vectorized decode consumes the dense
+    encoding; `None` means the processor is off, matching `ops/sampling.sample_token`)."""
+
+    do_sample: bool = False
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
+
+    def encoded(self) -> tuple[bool, float, int, float]:
+        return encode_sampling_params(self.do_sample, self.temperature, self.top_k, self.top_p)
+
+
+@dataclass
+class Request:
+    """One generation request: prompt tokens in, streamed tokens out."""
+
+    prompt_ids: list[int]
+    max_new_tokens: int
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_id: int | None = None
+    rng: Any = None  # jax PRNG key; engine derives one when None
+    deadline_s: float | None = None  # wall-clock budget from submit time
+    on_token: Callable[[int], None] | None = None  # streaming callback, one call per token
+    on_finish: Callable[["RequestState"], None] | None = None
+    request_id: int = -1  # assigned at submit
+
+
+@dataclass
+class RequestState:
+    """Lifecycle record the engine fills in as the request moves through the system."""
+
+    request: Request
+    status: RequestStatus = RequestStatus.waiting
+    tokens: list[int] = field(default_factory=list)
+    slot: int | None = None
+    submit_t: float = 0.0
+    first_token_t: float | None = None
+    finish_t: float | None = None
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def done(self) -> bool:
+        return self.status in (RequestStatus.completed, RequestStatus.cancelled)
+
+
+class Scheduler:
+    """Bounded FCFS admission over a slot pool.
+
+    The engine drives it: `submit` enqueues (or raises `QueueFullError`), `admissible`
+    yields the next waiting requests — up to the free-slot count — after cancelling any
+    whose deadline already passed, and `queue_depth` feeds telemetry.
+    """
+
+    def __init__(self, max_waiting: int = 128, clock: Callable[[], float] = time.monotonic):
+        assert max_waiting > 0
+        self.max_waiting = max_waiting
+        self.clock = clock
+        self.waiting: deque[RequestState] = deque()
+        self._ids = itertools.count()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def submit(self, request: Request) -> RequestState:
+        if len(self.waiting) >= self.max_waiting:
+            raise QueueFullError(
+                f"waiting queue is full ({self.max_waiting}); retry after the pool drains"
+            )
+        request.request_id = next(self._ids)
+        state = RequestState(request=request, submit_t=self.clock())
+        self.waiting.append(state)
+        return state
+
+    def expired(self, state: RequestState) -> bool:
+        deadline = state.request.deadline_s
+        return deadline is not None and (self.clock() - state.submit_t) > deadline
+
+    def admissible(self, free_slots: int) -> tuple[list[RequestState], list[RequestState]]:
+        """Pop up to `free_slots` requests FCFS. Returns (admit, expired): requests whose
+        deadline lapsed while waiting are popped too — cancelled, not admitted — so a
+        stale head never blocks the queue."""
+        admit: list[RequestState] = []
+        dead: list[RequestState] = []
+        while self.waiting and len(admit) < free_slots:
+            state = self.waiting.popleft()
+            (dead if self.expired(state) else admit).append(state)
+        return admit, dead

@@ -21,6 +21,8 @@ Sink schema (one JSON object per line; see docs/OBSERVABILITY.md):
     {"kind": "event",  "ts", "rank", "event", "step", ...}   # nan_skip, loader_stall, anomaly
     {"kind": "health", "ts", "rank", "step", "stats"}        # per-group norms (diagnostics.py)
     {"kind": "model_report", ...}                            # one-shot introspection (diagnostics.py)
+    {"kind": "serving", "ts", "rank", "step", "queue_depth", "slots_active", "num_slots",
+     "ttft_ms", "prefill_tok_s", "decode_tok_s", "counters"}  # serving engine (serving/engine.py)
     {"kind": "run_end","ts", "rank", "step", "status", "counters"}
 
 The full kind -> required-field table is :data:`RECORD_SCHEMA`;
@@ -90,6 +92,17 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
     # training health subsystem (utils/diagnostics.py)
     "health": ("step", "stats"),
     "model_report": ("param_groups", "totals", "hbm"),
+    # continuous-batching serving engine (serving/engine.py): queue/slot state is
+    # instantaneous, rates and counters are cumulative over the engine's lifetime
+    "serving": (
+        "queue_depth",
+        "slots_active",
+        "num_slots",
+        "ttft_ms",
+        "prefill_tok_s",
+        "decode_tok_s",
+        "counters",
+    ),
 }
 
 # every literal counter name used through the registry; `count(..., event=True)` names must
@@ -104,6 +117,13 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     "checkpoints_pruned",
     "loader_batches",
     "profiles_captured",
+    # serving engine (serving/engine.py)
+    "serving_requests_admitted",
+    "serving_requests_completed",
+    "serving_requests_rejected",
+    "serving_requests_cancelled",
+    "serving_prefill_tokens",
+    "serving_decode_tokens",
 )
 
 KNOWN_EVENTS: tuple[str, ...] = (

@@ -1,0 +1,464 @@
+"""Serving-engine tests: slot pool invariants, scheduler admission/deadlines, per-slot
+sampling isolation, EOS termination, and end-to-end parity vs `generate_tokens`.
+
+All model paths are unsharded (no mesh, no `init_params`) — the sharded-model path fails
+at seed from the logical-axis rules skew and would mask the feature under test.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.generation_utils import generate_tokens
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.ops.sampling import sample_token, sample_tokens_vectorized
+from dolomite_engine_tpu.serving import (
+    QueueFullError,
+    Request,
+    RequestStatus,
+    SamplingParams,
+    Scheduler,
+    ServingEngine,
+    SlotKVCachePool,
+    serve_batch,
+)
+
+from .test_commons import get_dense_test_config
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+# ---------------------------------------------------------------------------- pool
+
+
+def test_pool_alloc_reclaim_invariants():
+    config, model, _ = _tiny_model()
+    pool = SlotKVCachePool(model, num_slots=3, max_len=16)
+
+    slots = [pool.allocate() for _ in range(3)]
+    assert slots == [0, 1, 2]  # lowest-first, deterministic
+    assert pool.allocate() is None  # exhausted pool signals, never grows
+    assert pool.num_free == 0 and pool.num_active == 3 and pool.occupancy == 1.0
+
+    pool.lengths[1] = 7
+    pool.free(1)
+    assert pool.num_free == 1
+    assert pool.lengths[1] == 0  # reclamation resets the validity frontier
+    with pytest.raises(ValueError):
+        pool.free(1)  # double free
+    assert pool.allocate() == 1  # reclaimed slot is reusable
+
+    # cache shapes are the static decode layout
+    assert pool.caches[0]["k"].shape == (3, 16, config.num_key_value_heads, config.head_dim)
+    assert len(pool.caches) == config.n_layer
+
+
+def test_pool_write_prefill_requires_allocation():
+    _, model, _ = _tiny_model()
+    pool = SlotKVCachePool(model, num_slots=2, max_len=16)
+    prefill = model.init_kv_caches(1, 8)
+    with pytest.raises(ValueError):
+        pool.write_prefill(0, prefill, 5)  # slot 0 was never allocated
+    slot = pool.allocate()
+    pool.write_prefill(slot, prefill, 5)
+    assert pool.lengths[slot] == 5
+
+
+# ---------------------------------------------------------------------------- scheduler
+
+
+def test_scheduler_fcfs_and_queue_bound():
+    scheduler = Scheduler(max_waiting=2)
+    a = scheduler.submit(Request(prompt_ids=[1], max_new_tokens=1))
+    b = scheduler.submit(Request(prompt_ids=[2], max_new_tokens=1))
+    assert (a.request.request_id, b.request.request_id) == (0, 1)
+    with pytest.raises(QueueFullError):
+        scheduler.submit(Request(prompt_ids=[3], max_new_tokens=1))
+
+    admit, dead = scheduler.admissible(free_slots=1)
+    assert [s.request.request_id for s in admit] == [0] and not dead  # FCFS
+    admit, _ = scheduler.admissible(free_slots=4)
+    assert [s.request.request_id for s in admit] == [1]
+    assert scheduler.queue_depth == 0
+
+
+def test_scheduler_expired_waiters_are_not_admitted():
+    now = [0.0]
+    scheduler = Scheduler(max_waiting=4, clock=lambda: now[0])
+    stale = scheduler.submit(Request(prompt_ids=[1], max_new_tokens=1, deadline_s=5.0))
+    fresh = scheduler.submit(Request(prompt_ids=[2], max_new_tokens=1, deadline_s=None))
+    now[0] = 10.0
+    admit, dead = scheduler.admissible(free_slots=2)
+    assert dead == [stale] and admit == [fresh]  # stale head never blocks the queue
+
+
+# ---------------------------------------------------------------------------- sampling
+
+
+def test_per_slot_sampling_param_isolation():
+    """Every row of the vectorized sampler must reproduce a single-request sample_token
+    call with that row's own params — no cross-slot leakage of temperature/top-k/top-p."""
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(6, 64).astype(np.float32) * 3)
+    row_params = [
+        dict(do_sample=False, temperature=None, top_k=None, top_p=None),
+        dict(do_sample=True, temperature=None, top_k=None, top_p=None),
+        dict(do_sample=True, temperature=0.7, top_k=None, top_p=None),
+        dict(do_sample=True, temperature=1.3, top_k=5, top_p=None),
+        dict(do_sample=True, temperature=None, top_k=None, top_p=0.8),
+        dict(do_sample=True, temperature=0.9, top_k=10, top_p=0.95),
+    ]
+    keys = jnp.stack([jax.random.PRNGKey(100 + i) for i in range(len(row_params))])
+
+    expected = [
+        int(sample_token(logits[i : i + 1], keys[i], **p)[0]) for i, p in enumerate(row_params)
+    ]
+    encoded = [
+        SamplingParams(**p).encoded() for p in row_params
+    ]  # (do_sample, temperature, top_k, top_p)
+    got = sample_tokens_vectorized(
+        logits,
+        keys,
+        jnp.asarray([e[0] for e in encoded]),
+        jnp.asarray([e[1] for e in encoded], jnp.float32),
+        jnp.asarray([e[2] for e in encoded], jnp.int32),
+        jnp.asarray([e[3] for e in encoded], jnp.float32),
+    )
+    assert expected == [int(t) for t in got]
+
+
+# ---------------------------------------------------------------------------- engine
+
+
+def test_engine_matches_generate_tokens_e2e():
+    """Acceptance: requests with different prompt lengths and sampling params, submitted
+    asynchronously, decode token-for-token like equivalent one-shot generate_tokens
+    calls; the decode step compiles exactly once; every slot is reclaimed at drain."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(0)
+    prompts = [_random_prompt(rs, config, n) for n in (7, 13, 4, 9, 17)]
+    samplings = [
+        SamplingParams(),
+        SamplingParams(do_sample=True, temperature=0.8),
+        SamplingParams(do_sample=True, temperature=1.2, top_k=7),
+        SamplingParams(do_sample=True, top_p=0.9),
+        SamplingParams(do_sample=True, temperature=0.7, top_k=20, top_p=0.95),
+    ]
+    rngs = [jax.random.PRNGKey(100 + i) for i in range(5)]
+    max_new = 6
+
+    engine = ServingEngine(
+        model,
+        params,
+        num_slots=2,
+        max_len=64,
+        prefill_bucket_multiple=8,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+    )
+    streamed: dict[int, list[int]] = {}
+
+    def spec(i):
+        return dict(
+            prompt_ids=prompts[i],
+            max_new_tokens=max_new,
+            sampling=samplings[i],
+            rng=rngs[i],
+            on_token=lambda tok, i=i: streamed.setdefault(i, []).append(tok),
+        )
+
+    # asynchronous arrival: three requests up front, two more while decoding
+    states = [engine.submit(**spec(i)) for i in range(3)]
+    for _ in range(3):
+        engine.step()
+    states += [engine.submit(**spec(i)) for i in (3, 4)]
+    engine.drain()
+
+    for i, state in enumerate(states):
+        ids = jnp.asarray([prompts[i]], jnp.int32)
+        expected, num = generate_tokens(
+            model,
+            params,
+            ids,
+            jnp.ones_like(ids),
+            rngs[i],
+            max_new_tokens=max_new,
+            do_sample=samplings[i].do_sample,
+            temperature=samplings[i].temperature,
+            top_k=samplings[i].top_k,
+            top_p=samplings[i].top_p,
+            eos_token_id=None,
+            pad_token_id=config.pad_token_id,
+        )
+        assert state.status == RequestStatus.completed
+        assert state.tokens == [int(t) for t in np.asarray(expected[0])]
+        assert streamed[i] == state.tokens  # callbacks saw exactly the final tokens
+        assert state.ttft_s is not None and state.ttft_s >= 0
+
+    assert engine.decode_compiles == 1  # the static-shape invariant
+    assert engine.pool.num_free == engine.pool.num_slots  # all slots reclaimed
+    assert not engine.has_work()
+    assert engine.stats.completed == 5 and engine.stats.cancelled == 0
+
+
+def test_engine_eos_stops_and_frees_slot():
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(3)
+    prompt = _random_prompt(rs, config, 6)
+    max_new = 5
+
+    # unconstrained run picks the fake EOS (2nd generated token), like test_generation
+    engine = ServingEngine(
+        model, params, num_slots=1, max_len=32, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=0,
+    )
+    free_run = serve_batch(
+        engine, [dict(prompt_ids=prompt, max_new_tokens=max_new, rng=jax.random.PRNGKey(1))]
+    )[0]
+    fake_eos = free_run.tokens[1]
+    first = free_run.tokens.index(fake_eos)
+
+    engine2 = ServingEngine(
+        model, params, num_slots=1, max_len=32, prefill_bucket_multiple=8,
+        eos_token_id=fake_eos, pad_token_id=0,
+    )
+    state = serve_batch(
+        engine2, [dict(prompt_ids=prompt, max_new_tokens=max_new, rng=jax.random.PRNGKey(1))]
+    )[0]
+    assert state.status == RequestStatus.completed
+    assert state.num_generated == first + 1  # EOS counts as an emitted token
+    assert state.tokens[-1] == fake_eos
+    assert state.tokens == free_run.tokens[: first + 1]  # prefix unaffected by the stop
+    assert engine2.pool.num_free == 1
+
+
+def test_admission_under_full_pool_is_fcfs():
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(5)
+    engine = ServingEngine(
+        model, params, num_slots=1, max_len=32, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=0, max_waiting=8,
+    )
+    finish_order: list[int] = []
+    states = []
+    for i in range(3):
+        states.append(
+            engine.submit(
+                prompt_ids=_random_prompt(rs, config, 4 + i),
+                max_new_tokens=3,
+                on_finish=lambda st, i=i: finish_order.append(i),
+            )
+        )
+    # single slot: later requests wait in queue, never >1 running
+    assert [s.status for s in states] == [RequestStatus.waiting] * 3
+    while engine.has_work():
+        engine.step()
+        assert engine.pool.num_active <= 1
+    assert finish_order == [0, 1, 2]
+    assert engine.stats.admitted == 3 and engine.stats.completed == 3
+
+
+def test_queue_full_rejection():
+    config, model, params = _tiny_model()
+    engine = ServingEngine(
+        model, params, num_slots=1, max_len=32, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=0, max_waiting=2,
+    )
+    for _ in range(2):
+        engine.submit(prompt_ids=[5, 6, 7], max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        engine.submit(prompt_ids=[5, 6, 7], max_new_tokens=2)
+    assert engine.stats.rejected == 1
+    engine.drain()
+    assert engine.stats.completed == 2
+
+
+def test_request_validation():
+    config, model, params = _tiny_model()
+    engine = ServingEngine(
+        model, params, num_slots=1, max_len=16, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=0,
+    )
+    with pytest.raises(ValueError):
+        engine.submit(prompt_ids=[], max_new_tokens=2)
+    with pytest.raises(ValueError):
+        engine.submit(prompt_ids=[1, 2, 3], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        engine.submit(prompt_ids=[1] * 12, max_new_tokens=8)  # 12 + 8 > max_len=16
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, num_slots=1, max_len=16, prefill_bucket_multiple=7)
+    with pytest.raises(ValueError):
+        # cache cannot exceed the model's position budget
+        ServingEngine(model, params, num_slots=1, max_len=config.n_positions + 1)
+
+
+def test_deadline_cancellation_waiting_and_running():
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(7)
+    now = [0.0]
+    engine = ServingEngine(
+        model, params, num_slots=1, max_len=32, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=0, clock=lambda: now[0],
+    )
+    running = engine.submit(
+        prompt_ids=_random_prompt(rs, config, 5), max_new_tokens=20, deadline_s=4.0
+    )
+    waiting = engine.submit(
+        prompt_ids=_random_prompt(rs, config, 5), max_new_tokens=20, deadline_s=1.0
+    )
+    unconstrained = engine.submit(prompt_ids=_random_prompt(rs, config, 5), max_new_tokens=2)
+
+    engine.step()  # admits `running` (slot 0); `waiting` queued behind it
+    assert running.status == RequestStatus.running
+    now[0] = 2.0  # waiting's deadline lapses while queued; running still inside budget
+    engine.step()
+    now[0] = 5.0  # running's deadline lapses mid-decode
+    engine.drain()
+
+    assert waiting.status == RequestStatus.cancelled and waiting.slot is None
+    assert running.status == RequestStatus.cancelled
+    assert 0 < running.num_generated < 20  # produced some tokens, then cut off
+    assert unconstrained.status == RequestStatus.completed  # freed slot was reused
+    assert engine.pool.num_free == 1
+    assert engine.stats.cancelled == 2 and engine.stats.completed == 1
+
+
+def test_serving_telemetry_records(tmp_path):
+    from dolomite_engine_tpu.utils.telemetry import (
+        RECORD_SCHEMA,
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(11)
+    sink = tmp_path / "serving.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, prefill_bucket_multiple=8,
+            eos_token_id=None, pad_token_id=0,
+        )
+        serve_batch(
+            engine,
+            [dict(prompt_ids=_random_prompt(rs, config, 4 + i), max_new_tokens=3) for i in range(3)],
+        )
+        telemetry.close()
+    finally:
+        uninstall_telemetry()
+
+    records = [json.loads(line) for line in open(sink)]
+    serving = [r for r in records if r["kind"] == "serving"]
+    assert serving, "drain must emit a serving record"
+    final = serving[-1]
+    for field in RECORD_SCHEMA["serving"]:
+        assert field in final, field
+    assert final["queue_depth"] == 0 and final["slots_active"] == 0
+    assert final["num_slots"] == 2
+    assert final["counters"]["admitted"] == 3 and final["counters"]["completed"] == 3
+    assert final["counters"]["decode_tokens"] + 3 == 9  # 3 requests x 3 tokens, 1 from prefill each
+    # cross-module counters landed in the registry too
+    assert telemetry.counters["serving_requests_admitted"] == 3
+    assert telemetry.counters["serving_requests_completed"] == 3
+    assert telemetry.counters["serving_prefill_tokens"] == sum(4 + i for i in range(3))
+
+
+# ---------------------------------------------------------------------------- generate.py
+
+
+def test_generate_engine_path_writes_jsonl(tmp_path, monkeypatch):
+    """generate.generate() routes decoder-only datasets through the engine and keeps the
+    legacy jsonl contract (dataset order, generated_text/num_generated_tokens keys)."""
+    from dolomite_engine_tpu import generate as generate_module
+    from dolomite_engine_tpu.arguments import InferenceArgs
+    from dolomite_engine_tpu.data import get_datasets_list
+    from dolomite_engine_tpu.enums import DatasetSplit, Mode
+    from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning
+    from dolomite_engine_tpu.model_wrapper import base as mw_base
+
+    class _StubTokenizer:
+        eos_token_id = 1
+        pad_token_id = 2
+        vocab_size = 2048
+
+        def __len__(self):
+            return self.vocab_size
+
+        def decode(self, ids, skip_special_tokens=True):
+            return " ".join(str(int(i)) for i in ids)
+
+        def __call__(self, text, add_special_tokens=False):
+            return {"input_ids": [3 + (hash(text) + i) % 100 for i in range(4)]}
+
+    monkeypatch.setattr(
+        mw_base.ModelWrapper,
+        "_setup_tokenizer",
+        lambda self, name, extra: setattr(self, "tokenizer", _StubTokenizer()),
+    )
+
+    config = get_dense_test_config("mqa", "rope")
+    args = InferenceArgs(
+        model_args=dict(model_class="AutoModelForCausalLM", pretrained_config=config.to_dict()),
+        datasets=[
+            dict(
+                class_name="DebugDataset",
+                data_name="debug",
+                class_args=dict(num_examples=5, token_id=5),
+                max_input_tokens=6,
+                max_output_tokens=4,
+            )
+        ],
+        generation_parameters=dict(batch_size=2, max_new_tokens=3, prompt_bucket_multiple=8),
+        output_dir=str(tmp_path / "out"),
+    )
+
+    mode = Mode.inference
+    wrapper = ModelWrapperForFinetuning(
+        mode=mode,
+        model_name=None,
+        pretrained_config=config.to_dict(),
+        model_class="AutoModelForCausalLM",
+    )
+    # unsharded init: the mesh-sharded init_params path fails at seed (logical-axis skew)
+    params = wrapper.model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    datasets_list, _ = get_datasets_list(
+        dataset_args_list=args.datasets,
+        split=DatasetSplit.test,
+        mode=mode,
+        tokenizer=wrapper.tokenizer,
+        is_encoder_decoder=False,
+    )
+    generate_module.generate(args, wrapper, params, datasets_list, mode)
+
+    out_file = tmp_path / "out" / "output-debug.jsonl"
+    assert out_file.is_file()
+    lines = [json.loads(line) for line in open(out_file)]
+    assert len(lines) == 5
+    for line in lines:
+        assert "generated_text" in line
+        assert 0 < line["num_generated_tokens"] <= 3
+
+
+def test_generation_parameters_bucket_validation():
+    from dolomite_engine_tpu.arguments import GenerationParameters
+
+    with pytest.raises(ValueError):
+        GenerationParameters(batch_size=1, max_new_tokens=2, prompt_bucket_multiple=7)
+    with pytest.raises(ValueError):
+        GenerationParameters(batch_size=1, max_new_tokens=2, prompt_bucket_multiple=0)
+    gp = GenerationParameters(batch_size=1, max_new_tokens=2, prompt_bucket_multiple=16)
+    assert gp.prompt_bucket_multiple == 16

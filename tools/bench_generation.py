@@ -127,23 +127,87 @@ def main() -> None:
     short = (time.perf_counter() - t0) / args.reps
 
     decode_tok_s = args.batch * args.new / short  # decode-dominated (incl. short prefill)
-    print(
-        json.dumps(
-            {
-                "backend": backend,
-                "model": model_type,
-                "impl": "sdpa" if args.seq2seq else args.impl,
-                "batch": args.batch,
-                "prompt": args.prompt,
-                "short_prompt": short_len,
-                "new_tokens": args.new,
-                "e2e_s": round(total, 4),
-                "short_prompt_s": round(short, 4),
-                "prefill_delta_s": round(total - short, 4),
-                "decode_tok_s": round(decode_tok_s, 1),
-            }
-        )
+
+    record = {
+        "backend": backend,
+        "model": model_type,
+        "impl": "sdpa" if args.seq2seq else args.impl,
+        "batch": args.batch,
+        "prompt": args.prompt,
+        "short_prompt": short_len,
+        "new_tokens": args.new,
+        "e2e_s": round(total, 4),
+        "short_prompt_s": round(short, 4),
+        "prefill_delta_s": round(total - short, 4),
+        "decode_tok_s": round(decode_tok_s, 1),
+        # one-shot decode surfaces nothing until the whole batch returns, so its TTFT IS
+        # the end-to-end time — the number continuous batching exists to beat
+        "legacy": {
+            "ttft_s": round(total, 4),
+            "prefill_tok_s": round(
+                args.batch * (args.prompt - short_len) / max(total - short, 1e-9), 1
+            ),
+            "decode_tok_s": round(decode_tok_s, 1),
+        },
+    }
+
+    if not args.seq2seq:
+        record["engine"] = _bench_engine(model, params, config, args, short_len)
+
+    print(json.dumps(record))
+
+
+def _bench_engine(model, params, config, args, short_len: int) -> dict:
+    """Continuous-batching engine on the same model: 2x num_slots requests with mixed
+    prompt lengths, per-request TTFT, separate prefill/decode tokens-per-second from the
+    engine's own accounting (EngineStats)."""
+    import numpy as np
+
+    from dolomite_engine_tpu.serving import EngineStats, ServingEngine, serve_batch
+
+    multiple = 64 if jax.default_backend() == "tpu" else 16
+    max_len = -(-args.prompt // multiple) * multiple + args.new
+    engine = ServingEngine(
+        model,
+        params,
+        num_slots=args.batch,
+        max_len=max_len,
+        prefill_bucket_multiple=multiple,
+        max_waiting=4 * args.batch,
+        eos_token_id=None,  # every request decodes the full budget (pure throughput)
+        pad_token_id=config.pad_token_id,
     )
+
+    rs = np.random.RandomState(1)
+
+    def specs(n):
+        return [
+            dict(
+                prompt_ids=list(
+                    map(int, rs.randint(3, config.vocab_size, args.prompt if i % 2 else short_len))
+                ),
+                max_new_tokens=args.new,
+            )
+            for i in range(n)
+        ]
+
+    serve_batch(engine, specs(2))  # compile prefill buckets + the decode step
+    engine.stats = EngineStats()  # drop warmup/compile time from the measured window
+
+    t0 = time.perf_counter()
+    serve_batch(engine, specs(2 * args.batch))
+    e2e = time.perf_counter() - t0
+
+    stats = engine.stats
+    return {
+        "num_slots": args.batch,
+        "requests": 2 * args.batch,
+        "e2e_s": round(e2e, 4),
+        "ttft_mean_s": round(stats.mean_ttft_s() or 0.0, 4),
+        "prefill_tok_s": round(stats.prefill_tok_s() or 0.0, 1),
+        "decode_tok_s": round(stats.decode_tok_s() or 0.0, 1),
+        "decode_compiles": engine.decode_compiles,
+    }
 
 
 if __name__ == "__main__":

@@ -1,31 +1,130 @@
-"""Single-device generation demo (reference `tools/inference.py`)."""
+"""Interactive generation CLI driving the continuous-batching engine.
 
+    python tools/inference.py --model /path/to/dolomite-model \
+        --prompt "def factorial(x):" --max-new-tokens 100 --stream
+
+Replaces the old hardcoded single-prompt demo (reference `tools/inference.py`): model
+path, prompts, and sampling settings are flags; multiple --prompt flags (or
+--prompt-file) decode concurrently through the slot pool; --stream prints tokens as the
+engine emits them. For batch workloads with telemetry and JSONL output use
+tools/serve.py; for dataset-driven generation use `python -m dolomite_engine_tpu.generate`.
+"""
+
+import argparse
 import os
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-from dolomite_engine_tpu.enums import Mode  # noqa: E402
-from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning  # noqa: E402
-from dolomite_engine_tpu.parallel.mesh import MeshManager  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SYSTEM_PROMPT = "<|system|>\nYou are a cautious assistant. You carefully follow instructions."
 USER_PROMPT = "<|user|>\n{value}\n"
 ASSISTANT = "<|assistant|>\n"
 
-text = "def factorial(x):"
-prompt = SYSTEM_PROMPT + USER_PROMPT.format(value=text) + ASSISTANT
 
-model_path = "<path to dolomite format model>"
+def parse_args() -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", required=True, help="dolomite-format model path or hub id")
+    p.add_argument("--prompt", action="append", default=[], help="prompt text (repeatable)")
+    p.add_argument("--prompt-file", help="file with one prompt per line")
+    p.add_argument(
+        "--chat",
+        action="store_true",
+        help="wrap each prompt in the system/user/assistant chat template",
+    )
+    p.add_argument("--max-new-tokens", type=int, default=100)
+    p.add_argument("--do-sample", action="store_true")
+    p.add_argument("--temperature", type=float, default=None)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--num-slots", type=int, default=4, help="max concurrent requests")
+    p.add_argument("--bucket-multiple", type=int, default=64, help="prefill width bucket")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--stream",
+        action="store_true",
+        help="print tokens as they decode (single prompt only)",
+    )
+    return p.parse_args()
 
-MeshManager()
-model = ModelWrapperForFinetuning(mode=Mode.inference, model_name=model_path)
-params = model.load_pretrained_params(model_path, MeshManager.get_mesh())
 
-x = model.tokenizer([prompt], return_tensors="np")
-batch = {
-    "input_ids": x["input_ids"].astype("int32"),
-    "attention_mask": x["attention_mask"].astype("int32"),
-}
-texts, _ = model.generate(params, batch, {"max_new_tokens": 100})
-print(prompt + texts[0])
+def main() -> None:
+    args = parse_args()
+
+    prompts = list(args.prompt)
+    if args.prompt_file:
+        with open(args.prompt_file) as f:
+            prompts.extend(line.rstrip("\n") for line in f if line.strip())
+    if not prompts:
+        raise SystemExit("no prompts: pass --prompt and/or --prompt-file")
+    if args.chat:
+        prompts = [SYSTEM_PROMPT + USER_PROMPT.format(value=text) + ASSISTANT for text in prompts]
+    if args.stream and len(prompts) > 1:
+        raise SystemExit("--stream supports a single prompt (others would interleave)")
+
+    import jax
+
+    from dolomite_engine_tpu.enums import Mode
+    from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+    from dolomite_engine_tpu.serving import SamplingParams, ServingEngine, serve_batch
+
+    if not MeshManager.is_initialized():
+        MeshManager()
+    model = ModelWrapperForFinetuning(mode=Mode.inference, model_name=args.model)
+    params = model.load_pretrained_params(args.model, MeshManager.get_mesh())
+    assert model.tokenizer is not None, "generation requires a tokenizer"
+
+    prompt_ids = [
+        model.tokenizer(text, add_special_tokens=False)["input_ids"] for text in prompts
+    ]
+    multiple = args.bucket_multiple
+    longest = max(len(ids) for ids in prompt_ids)
+    max_len = -(-longest // multiple) * multiple + args.max_new_tokens
+
+    pad_token_id = next(
+        (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
+    )
+    engine = ServingEngine(
+        model.model,
+        params,
+        num_slots=args.num_slots,
+        max_len=max_len,
+        prefill_bucket_multiple=multiple,
+        eos_token_id=model.eos_token_id,
+        pad_token_id=pad_token_id,
+        rng=jax.random.PRNGKey(args.seed),
+    )
+
+    sampling = SamplingParams(
+        do_sample=args.do_sample,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+    )
+
+    def stream_token(token_id: int) -> None:
+        print(model.tokenizer.decode([token_id], skip_special_tokens=True), end="", flush=True)
+
+    if args.stream:
+        print(prompts[0], end="", flush=True)
+    specs = [
+        dict(
+            prompt_ids=ids,
+            max_new_tokens=args.max_new_tokens,
+            sampling=sampling,
+            on_token=stream_token if args.stream else None,
+        )
+        for ids in prompt_ids
+    ]
+    states = serve_batch(engine, specs)
+
+    if args.stream:
+        print()
+        return
+    for text, state in zip(prompts, states):
+        print(text + model.tokenizer.decode(state.tokens, skip_special_tokens=True))
+        print("---")
+
+
+if __name__ == "__main__":
+    main()

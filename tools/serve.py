@@ -1,0 +1,163 @@
+"""Offline continuous-batching driver: prompts in, streamed generations + serving stats out.
+
+    python tools/serve.py --model /path/to/dolomite-model \
+        --prompt "def factorial(x):" --prompt "fibonacci in rust" \
+        --max-new-tokens 128 --num-slots 8 --do-sample --temperature 0.8
+
+Every prompt becomes one request with its own sampling params and deadline; the engine
+(dolomite_engine_tpu/serving/) admits them into KV slots as capacity frees up and the
+decode step stays one compiled program throughout. Results print as JSONL in submission
+order; a summary (TTFT, prefill/decode tokens per second, admission counters) goes to
+stderr, and --telemetry-sink additionally records the full `serving` JSONL schema
+(docs/SERVING.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args() -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", required=True, help="dolomite-format model path or hub id")
+    p.add_argument("--prompt", action="append", default=[], help="prompt text (repeatable)")
+    p.add_argument("--prompt-file", help="file with one prompt per line")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--do-sample", action="store_true")
+    p.add_argument("--temperature", type=float, default=None)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--num-slots", type=int, default=8, help="max concurrent requests")
+    p.add_argument(
+        "--max-len",
+        type=int,
+        default=None,
+        help="per-slot cache length (default: longest prompt bucket + max_new_tokens)",
+    )
+    p.add_argument("--bucket-multiple", type=int, default=64, help="prefill width bucket")
+    p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
+    p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", help="write JSONL here instead of stdout")
+    p.add_argument("--telemetry-sink", help="serving telemetry JSONL path")
+    return p.parse_args()
+
+
+def main() -> None:
+    args = parse_args()
+
+    prompts = list(args.prompt)
+    if args.prompt_file:
+        with open(args.prompt_file) as f:
+            prompts.extend(line.rstrip("\n") for line in f if line.strip())
+    if not prompts:
+        raise SystemExit("no prompts: pass --prompt and/or --prompt-file")
+
+    import jax
+
+    from dolomite_engine_tpu.enums import Mode
+    from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+    from dolomite_engine_tpu.serving import SamplingParams, ServingEngine, serve_batch
+    from dolomite_engine_tpu.utils.telemetry import Telemetry, install_telemetry
+
+    if not MeshManager.is_initialized():
+        MeshManager()
+    model = ModelWrapperForFinetuning(mode=Mode.inference, model_name=args.model)
+    params = model.load_pretrained_params(args.model, MeshManager.get_mesh())
+    assert model.tokenizer is not None, "serving requires a tokenizer"
+
+    telemetry = None
+    if args.telemetry_sink:
+        telemetry = Telemetry(sink_path=args.telemetry_sink)
+        install_telemetry(telemetry)
+
+    prompt_ids = [
+        model.tokenizer(text, add_special_tokens=False)["input_ids"] for text in prompts
+    ]
+    multiple = args.bucket_multiple
+    max_len = args.max_len
+    if max_len is None:
+        longest = max(len(ids) for ids in prompt_ids)
+        max_len = -(-longest // multiple) * multiple + args.max_new_tokens
+
+    pad_token_id = next(
+        (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
+    )
+    engine = ServingEngine(
+        model.model,
+        params,
+        num_slots=args.num_slots,
+        max_len=max_len,
+        prefill_bucket_multiple=multiple,
+        max_waiting=args.max_waiting,
+        eos_token_id=model.eos_token_id,
+        pad_token_id=pad_token_id,
+        rng=jax.random.PRNGKey(args.seed),
+        record_interval=100,
+    )
+
+    sampling = SamplingParams(
+        do_sample=args.do_sample,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+    )
+    specs = [
+        dict(
+            prompt_ids=ids,
+            max_new_tokens=args.max_new_tokens,
+            sampling=sampling,
+            deadline_s=args.deadline_s,
+        )
+        for ids in prompt_ids
+    ]
+    states = serve_batch(engine, specs)
+
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for text, state in zip(prompts, states):
+            out.write(
+                json.dumps(
+                    {
+                        "prompt": text,
+                        "generated_text": model.tokenizer.decode(
+                            state.tokens, skip_special_tokens=True
+                        ),
+                        "num_generated_tokens": state.num_generated,
+                        "status": str(state.status),
+                        "ttft_ms": None
+                        if state.ttft_s is None
+                        else round(state.ttft_s * 1e3, 1),
+                    }
+                )
+                + "\n"
+            )
+    finally:
+        if out is not sys.stdout:
+            out.close()
+
+    if telemetry is not None:
+        telemetry.close()
+
+    stats = engine.stats
+    ttft = stats.mean_ttft_s()
+    prefill_rate = stats.prefill_tok_s()
+    decode_rate = stats.decode_tok_s()
+    print(
+        f"served {len(states)} request(s): "
+        f"completed={stats.completed} cancelled={stats.cancelled}, "
+        f"ttft={'n/a' if ttft is None else f'{ttft * 1e3:.0f}ms'}, "
+        f"prefill={'n/a' if prefill_rate is None else f'{prefill_rate:.0f}'} tok/s, "
+        f"decode={'n/a' if decode_rate is None else f'{decode_rate:.0f}'} tok/s, "
+        f"decode compiles={engine.decode_compiles}, "
+        f"free slots={engine.pool.num_free}/{engine.pool.num_slots}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
