@@ -199,6 +199,12 @@ class TrainingParameters(BaseArgs):
     loss_mask: LossMask = LossMask.output_only
     # global-norm gradient clipping threshold
     gradient_clipping: float | None = 1
+    # async input pipeline (data/prefetch.py): step batches assembled and placed on device
+    # by a background thread, up to this many buffered ahead of the loop so host data work
+    # and H2D transfer overlap the previous jitted step. 0 = fully synchronous path
+    # (byte-identical batch order, no thread). Resume stays exact at any depth: the
+    # prefetcher's checkpoint state replays batches buffered but not yet consumed
+    prefetch_depth: int = 2
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(
@@ -210,6 +216,11 @@ class TrainingParameters(BaseArgs):
 
         if self.eval_during_training:
             _check_not_None([(self.eval_interval, "eval_interval")])
+
+        assert self.prefetch_depth >= 0, (
+            f"prefetch_depth must be >= 0 (got {self.prefetch_depth}); 0 disables the "
+            "async input pipeline"
+        )
 
 
 class SaveArgs(BaseArgs):

@@ -18,6 +18,7 @@ from ..enums import DatasetSplit, Mode
 from ..utils import log_rank_0
 from .base import BaseDataset, BlendedDatasets
 from .dataloader import DispatchingDataLoader, ResumableDataLoader, ShardedDataLoader
+from .prefetch import PrefetchingIterable, StepPrefetcher
 from .debug import DebugDataset
 from .huggingface import HuggingFaceDataset, JSONLinesDataset, SST2Dataset
 from .instruction_tuning import AlpacaDataset, DollyDataset, SlimOrcaDataset

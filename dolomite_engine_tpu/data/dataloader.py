@@ -210,11 +210,14 @@ class DispatchingDataLoader:
                 code = int(header[row, 0])
                 full[key] = None if code <= 0 else np.asarray(next(it))
             with trace_annotation("dataloader_assemble"):
+                # one device_put per array: XLA slices each device's shard itself — same
+                # placement as the per-key make_array_from_callback lambdas this replaces,
+                # without one host callback per (key, device). Every process holds the
+                # full broadcast batch, which is exactly device_put's multi-process
+                # contract (same global value on all hosts).
                 out = {
                     key: (
-                        jax.make_array_from_callback(
-                            value.shape, self.sharding, lambda idx, v=value: v[idx]
-                        )
+                        jax.device_put(value, self.sharding)
                         if value is not None
                         else None
                     )

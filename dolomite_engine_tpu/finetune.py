@@ -8,6 +8,9 @@ periodic eval/save; `evaluate` (156-211) is a full pass over the val loader.
 TPU deltas: the train step is ONE jitted function over the whole global-step batch (micro-batch
 grad accumulation via `lax.scan` inside, see `train_utils.make_train_step`); there is no
 torch-profiler/no_sync/clip plumbing in the loop body — those live inside the jitted step.
+The reference's `infinite_iterator(train_dataloader)` is subsumed by the async input pipeline
+(`data/prefetch.py` StepPrefetcher, `training_parameters.prefetch_depth`): a background worker
+cycles the loader, stacks each step's micros and places them on device ahead of the loop.
 """
 
 from __future__ import annotations
@@ -26,7 +29,7 @@ from .checkpointing import (
     finish_pending_checkpoint,
     save_checkpoint,
 )
-from .data import get_dataloader, infinite_iterator
+from .data import PrefetchingIterable, StepPrefetcher, get_dataloader
 from .distributed import build_mesh_from_args, create_sharded_train_state
 from .enums import DatasetSplit, Mode, TuningMethod
 from .model_wrapper import get_model, log_model
@@ -166,8 +169,24 @@ def train(
                 val_dataloader, model, state, starting_iteration, experiments_tracker, eval_step
             )
 
-    micro_batches_per_step = gradient_accumulation_steps
-    batch_iter = infinite_iterator(train_dataloader)
+    # async input pipeline (data/prefetch.py): a background worker drains the dataloader,
+    # stacks each step's micros and places them on device up to prefetch_depth batches
+    # ahead, so host data work overlaps the previous jitted step. finetune.main wraps
+    # BEFORE checkpoint load so resume state flows through the prefetcher; callers that
+    # pass a bare loader (tests driving train() directly) get wrapped here
+    prefetcher = train_dataloader
+    if not isinstance(prefetcher, StepPrefetcher):
+        prefetcher = StepPrefetcher(
+            train_dataloader,
+            depth=args.training_parameters.prefetch_depth,
+            micros_per_step=gradient_accumulation_steps,
+            assemble_fn=_stack_micro_batches,
+            loop=True,
+            description="train dataloader",
+        )
+    # the watchdog wraps the prefetcher's next() — in async mode that bounds the queue
+    # get, so a wedged prefetch worker still trips the stall abort
+    batch_iter = prefetcher
     if ft_args.dataloader_stall_timeout_seconds is not None:
         batch_iter = StallWatchdog(
             batch_iter,
@@ -191,14 +210,15 @@ def train(
     try:
         while global_step < num_training_steps:
             global_step += 1
-            fetch_start = time.perf_counter()
 
-            with trace_annotation("data_fetch"):
-                micro_batches = [next(batch_iter) for _ in range(micro_batches_per_step)]
-                batch = _stack_micro_batches(micro_batches)
+            # the prefetcher yields the full step batch (micros pre-stacked, on device);
+            # the data bucket charges only the time the loop truly waited on data —
+            # residual queue wait in async mode, the raw micro fetch at prefetch_depth=0
+            # (assembly is excluded in both modes and lands in the `other` bucket)
+            batch = next(batch_iter)
+            data_seconds = prefetcher.last_wait_seconds
 
             step_start = time.perf_counter()
-            data_seconds = step_start - fetch_start
 
             jax_rng, step_rng = jax.random.split(jax_rng)
             with get_profiler_context(
@@ -267,11 +287,14 @@ def train(
 
             if global_step % save_interval == 0 or global_step == num_training_steps:
                 with telemetry.timer("checkpoint"):
+                    # the PREFETCHER's state, not the loader's: the loader runs ahead of
+                    # consumption, the prefetcher's snapshot+skip accounts for batches
+                    # buffered but not yet consumed (resume-exact at any depth)
                     save_checkpoint(
                         args,
                         model,
                         state,
-                        train_dataloader,
+                        prefetcher,
                         experiments_tracker,
                         global_step,
                         jax_rng=jax_rng,
@@ -297,7 +320,7 @@ def train(
                             args,
                             model,
                             state,
-                            train_dataloader,
+                            prefetcher,
                             experiments_tracker,
                             global_step,
                             jax_rng=jax_rng,
@@ -317,6 +340,7 @@ def train(
         unregister_crash_hook(monitor.dump_flight_record)
         if isinstance(batch_iter, StallWatchdog):
             batch_iter.close()
+        prefetcher.close()  # every exit path shuts the prefetch worker down
         telemetry.close("preempted" if preempted else exit_status)
         uninstall_telemetry()
 
@@ -409,6 +433,26 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
             model.tokenizer,
             is_encoder_decoder=model.is_encoder_decoder,
             mesh=mesh,
+        )
+
+    # async input pipeline: wrap BEFORE checkpoint load so dataloader resume state flows
+    # through the prefetcher (its state accounts for batches buffered but not consumed);
+    # assembly runs on the worker thread under this mesh, overlapping the jitted step
+    prefetch_depth = args.training_parameters.prefetch_depth
+    if train_dataloader is not None:
+        train_dataloader = StepPrefetcher(
+            train_dataloader,
+            depth=prefetch_depth,
+            micros_per_step=args.training_parameters.gradient_accumulation_steps,
+            assemble_fn=_stack_micro_batches,
+            loop=True,
+            mesh=mesh,
+            description="train dataloader",
+        )
+    if val_dataloader is not None:
+        # restartable per-pass prefetch: evaluate() does one full pass per interval
+        val_dataloader = PrefetchingIterable(
+            val_dataloader, prefetch_depth, description="val dataloader"
         )
 
     optimizer, lr_schedule = build_optimizer_from_args(args, model)

@@ -26,6 +26,7 @@ from .checkpointing import (
     finish_pending_checkpoint,
     save_checkpoint,
 )
+from .data import StepPrefetcher
 from .data.megatron import get_megatron_gpt_dataloaders
 from .distributed import (
     build_mesh_from_args,
@@ -153,6 +154,7 @@ def train(
     starting_iteration: int = 0,
     consumed_samples: int = 0,
     jax_rng: jax.Array | None = None,
+    mesh=None,
 ) -> None:
     """Main pretraining loop (reference `pretrain.py:60-219`)."""
     num_training_steps = args.training_parameters.num_training_steps
@@ -229,6 +231,37 @@ def train(
     if jax_rng is None:
         jax_rng = jax.random.PRNGKey(args.random_args.seed)
 
+    # async input pipeline (data/prefetch.py): the step batch ({"text": [accum, ...]}) is
+    # assembled and device-placed by a background worker up to prefetch_depth ahead.
+    # Megatron loaders resume via consumed_samples metadata (no dataloader state in the
+    # checkpoint), so buffered-but-unconsumed batches are simply regenerated on restart —
+    # consumed_samples only advances per consumed step
+    prefetch_depth = args.training_parameters.prefetch_depth
+    prefetcher = train_dataloader
+    if not isinstance(prefetcher, StepPrefetcher):
+        prefetcher = StepPrefetcher(
+            train_dataloader,
+            depth=prefetch_depth,
+            micros_per_step=gradient_accumulation_steps,
+            assemble_fn=lambda micros: {"text": jnp.stack([m["text"] for m in micros])},
+            mesh=mesh,
+            description="megatron train dataloader",
+        )
+    # eval loaders are consumed incrementally (eval_steps batches per interval): a
+    # persistent single-pass prefetcher per group keeps the next eval's batches warm
+    val_dataloaders = [
+        dl
+        if dl is None or isinstance(dl, StepPrefetcher)
+        else StepPrefetcher(dl, depth=prefetch_depth, description="val dataloader")
+        for dl in val_dataloaders
+    ]
+    test_dataloaders = [
+        dl
+        if dl is None or isinstance(dl, StepPrefetcher)
+        else StepPrefetcher(dl, depth=prefetch_depth, description="test dataloader")
+        for dl in test_dataloaders
+    ]
+
     val_group_names = get_group_names(args, "val_weighted_split_paths")
 
     if eval_during_training and starting_iteration == 0 and eval_steps:
@@ -244,7 +277,9 @@ def train(
                 group_names=val_group_names,
             )
 
-    batch_iter = train_dataloader
+    # the watchdog wraps the prefetcher's next() — in async mode that bounds the queue
+    # get, so a wedged prefetch worker still trips the stall abort
+    batch_iter = prefetcher
     if ft_args.dataloader_stall_timeout_seconds is not None:
         batch_iter = StallWatchdog(
             batch_iter,
@@ -268,14 +303,15 @@ def train(
     try:
         while global_step < num_training_steps:
             global_step += 1
-            fetch_start = time.perf_counter()
 
-            with trace_annotation("data_fetch"):
-                micros = [next(batch_iter) for _ in range(gradient_accumulation_steps)]
-                batch = {"text": jnp.stack([m["text"] for m in micros])}
+            # the prefetcher yields the full step batch (micros pre-stacked, on device);
+            # the data bucket charges only the time the loop truly waited on data —
+            # residual queue wait in async mode, the raw micro fetch at prefetch_depth=0
+            # (assembly is excluded in both modes and lands in the `other` bucket)
+            batch = next(batch_iter)
+            data_seconds = prefetcher.last_wait_seconds
 
             step_start = time.perf_counter()
-            data_seconds = step_start - fetch_start
 
             jax_rng, step_rng = jax.random.split(jax_rng)
             with get_profiler_context(
@@ -418,6 +454,12 @@ def train(
         unregister_crash_hook(monitor.dump_flight_record)
         if isinstance(batch_iter, StallWatchdog):
             batch_iter.close()
+        # every exit path shuts the prefetch workers down (test loaders stay open for the
+        # final evaluation below and are closed after it)
+        prefetcher.close()
+        for dl in val_dataloaders:
+            if isinstance(dl, StepPrefetcher):
+                dl.close()
         telemetry.close("preempted" if preempted else exit_status)
         uninstall_telemetry()
 
@@ -425,16 +467,21 @@ def train(
     # training; val was already evaluated in-loop at this step when the interval divides);
     # a preempted run skips it — the grace window is for saving
     if not preempted and eval_during_training and eval_steps:
-        test_loss = evaluate(
-            test_dataloaders,
-            model,
-            state,
-            global_step,
-            None,
-            eval_steps,
-            eval_step_fn,
-            group_names=get_group_names(args, "test_weighted_split_paths"),
-        )
+        try:
+            test_loss = evaluate(
+                test_dataloaders,
+                model,
+                state,
+                global_step,
+                None,
+                eval_steps,
+                eval_step_fn,
+                group_names=get_group_names(args, "test_weighted_split_paths"),
+            )
+        finally:
+            for dl in test_dataloaders:
+                if isinstance(dl, StepPrefetcher):
+                    dl.close()
         if test_loss is not None:
             if experiments_tracker is not None:
                 experiments_tracker.track({"loss": test_loss}, step=global_step, context="test")
@@ -509,6 +556,7 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
             starting_iteration=starting_iteration,
             consumed_samples=consumed_samples,
             jax_rng=jax_rng,
+            mesh=mesh,
         )
 
     experiments_tracker.finish()

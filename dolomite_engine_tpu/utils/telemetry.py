@@ -7,7 +7,9 @@ local sink (zero optional deps), rank-0 scalars additionally fan out to the exis
 :class:`~dolomite_engine_tpu.utils.tracking.ExperimentsTracker`, and the train loops feed a
 **goodput breakdown** — first-step compile, dataloader wait, jitted step, checkpoint-blocking,
 eval — from which steady-state MFU (vs detected per-device peak FLOPs) and goodput %% are
-derived per logging window.
+derived per logging window. With the async input pipeline on (``prefetch_depth > 0``,
+data/prefetch.py) the ``data`` bucket measures only *residual* prefetch-queue wait — batch
+assembly and H2D transfer run on the prefetch worker, overlapped with the previous step.
 
 Sink schema (one JSON object per line; see docs/OBSERVABILITY.md):
 
@@ -117,6 +119,9 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     "checkpoints_pruned",
     "loader_batches",
     "profiles_captured",
+    # async input pipeline (data/prefetch.py): consumer found the prefetch queue empty at
+    # a steady-state step — the background worker is not keeping up with the loop
+    "prefetch_stalls",
     # serving engine (serving/engine.py)
     "serving_requests_admitted",
     "serving_requests_completed",
@@ -134,6 +139,17 @@ KNOWN_EVENTS: tuple[str, ...] = (
     "profile_start",
     "profiles_captured",
     "anomaly",
+)
+
+# every literal gauge name set through the registry (dynamic names — the per-device
+# memory/host-RSS fan-out in collect_memory_gauges — are exempt, same rule as counters);
+# scripts/check_telemetry_schema.py validates .gauge() call sites against this table
+KNOWN_GAUGES: tuple[str, ...] = (
+    # async input pipeline (data/prefetch.py): queue occupancy after each consumed batch
+    "prefetch/queue_depth",
+    # serving engine (serving/engine.py)
+    "serving/queue_depth",
+    "serving/slot_occupancy",
 )
 
 # goodput buckets, in reporting order; "other" is the window remainder (python overhead,

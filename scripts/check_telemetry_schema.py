@@ -9,6 +9,8 @@ scanned code) and validates each telemetry call site against the tables declared
 - ``*.count("name", ...)``       -> name in ``KNOWN_COUNTERS``; with ``event=True`` the name
   must also be in ``KNOWN_EVENTS`` (it writes an event record under that name)
 - ``*.event("name", ...)``       -> name in ``KNOWN_EVENTS``
+- ``*.gauge("name", ...)``       -> name in ``KNOWN_GAUGES`` (dynamic names — the
+  per-device memory fan-out — are exempt, same rule as counters)
 - ``*.emit_record("kind", ...)`` -> kind in ``RECORD_SCHEMA``; literal keyword fields must
   cover the kind's required fields (calls forwarding ``**fields`` are kind-checked only)
 - ``{"kind": "x", ...}`` dict literals (the internal ``_emit`` payloads) -> kind declared in
@@ -60,12 +62,14 @@ def check_package(package_dir: str = PACKAGE_DIR) -> list[str]:
     from dolomite_engine_tpu.utils.telemetry import (
         KNOWN_COUNTERS,
         KNOWN_EVENTS,
+        KNOWN_GAUGES,
         RECORD_SCHEMA,
     )
 
     errors: list[str] = []
     used_counters: set[str] = set()
     used_events: set[str] = set()
+    used_gauges: set[str] = set()
     used_kinds: set[str] = set()
 
     for dirpath, _dirnames, filenames in os.walk(package_dir):
@@ -115,7 +119,7 @@ def check_package(package_dir: str = PACKAGE_DIR) -> list[str]:
                 if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
                     continue
                 method = node.func.attr
-                if method not in ("count", "event", "emit_record"):
+                if method not in ("count", "event", "gauge", "emit_record"):
                     continue
                 if not _is_telemetry_receiver(node, path):
                     continue
@@ -148,6 +152,12 @@ def check_package(package_dir: str = PACKAGE_DIR) -> list[str]:
                         errors.append(
                             f"{rel}:{node.lineno}: event '{name}' not in KNOWN_EVENTS"
                         )
+                elif method == "gauge":
+                    used_gauges.add(name)
+                    if name not in KNOWN_GAUGES:
+                        errors.append(
+                            f"{rel}:{node.lineno}: gauge '{name}' not in KNOWN_GAUGES"
+                        )
                 elif method == "emit_record":
                     used_kinds.add(name)
                     if name not in RECORD_SCHEMA:
@@ -174,6 +184,9 @@ def check_package(package_dir: str = PACKAGE_DIR) -> list[str]:
     for name in KNOWN_EVENTS:
         if name not in used_events:
             errors.append(f"KNOWN_EVENTS entry '{name}' has no call site in the package")
+    for name in KNOWN_GAUGES:
+        if name not in used_gauges:
+            errors.append(f"KNOWN_GAUGES entry '{name}' has no call site in the package")
     for kind in RECORD_SCHEMA:
         if kind not in used_kinds:
             errors.append(f"RECORD_SCHEMA kind '{kind}' is never written in the package")
