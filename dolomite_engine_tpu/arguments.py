@@ -673,6 +673,21 @@ class GenerationParameters(BaseArgs):
     # batch (generate.py, serving/engine.py). Must be a positive multiple of 8 (TPU lane
     # alignment; 64 keeps compile counts low for typical prompt spreads).
     prompt_bucket_multiple: int = 64
+    # ---- serving KV memory model (serving/kv_cache.py, docs/SERVING.md) ----
+    # paged KV pool (vLLM-style block tables with static shapes) vs the dense
+    # [num_slots, max_len] slot pool; paged is the default and enables prefix caching
+    # and chunked prefill
+    paged_kv_cache: bool = True
+    # tokens per KV page; must be a positive multiple of 8 (TPU lane alignment)
+    kv_page_size: int = 16
+    # physical pages in the pool (None = dense-parity capacity); set to the HBM budget
+    # to oversubscribe slots — admission reserves worst-case pages, so decode never OOMs
+    kv_num_pages: int | None = None
+    # per-engine-step prefill token budget (chunked prefill): long prompts are computed
+    # in chunks interleaved with decode steps; positive multiple of 8
+    prefill_chunk_tokens: int = 512
+    # share page-aligned resident prompt prefixes across requests (RadixAttention-style)
+    prefix_caching: bool = True
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(
@@ -682,6 +697,20 @@ class GenerationParameters(BaseArgs):
             raise ValueError(
                 f"prompt_bucket_multiple must be a positive multiple of 8, got "
                 f"{self.prompt_bucket_multiple}"
+            )
+        if self.kv_page_size <= 0 or self.kv_page_size % 8 != 0:
+            raise ValueError(
+                f"kv_page_size must be a positive multiple of 8, got {self.kv_page_size}"
+            )
+        if self.prefill_chunk_tokens <= 0 or self.prefill_chunk_tokens % 8 != 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be a positive multiple of 8, got "
+                f"{self.prefill_chunk_tokens}"
+            )
+        if self.kv_num_pages is not None and self.kv_num_pages < 2:
+            raise ValueError(
+                f"kv_num_pages must be >= 2 (page 0 is the trash page), got "
+                f"{self.kv_num_pages}"
             )
 
 

@@ -39,8 +39,17 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
     save_args(args, args.output_dir, mode)
 
     generate_kwargs = args.generation_parameters.to_dict()
-    generate_kwargs.pop("batch_size", None)
-    generate_kwargs.pop("prompt_bucket_multiple", None)
+    # engine-only knobs: not part of the legacy generate_tokens signature
+    for key in (
+        "batch_size",
+        "prompt_bucket_multiple",
+        "paged_kv_cache",
+        "kv_page_size",
+        "kv_num_pages",
+        "prefill_chunk_tokens",
+        "prefix_caching",
+    ):
+        generate_kwargs.pop(key, None)
 
     progress_bar = ProgressBar(0, sum(len(dataset) for dataset in datasets_list))
     rng = jax.random.PRNGKey(args.random_args.seed or 0)
@@ -132,6 +141,11 @@ def _generate_with_engine(
         max_waiting=max(2 * gp.batch_size, 8),
         eos_token_id=model.eos_token_id,
         pad_token_id=pad_token_id,
+        paged=gp.paged_kv_cache,
+        page_size=gp.kv_page_size,
+        num_pages=gp.kv_num_pages,
+        prefill_chunk_tokens=gp.prefill_chunk_tokens,
+        prefix_caching=gp.prefix_caching,
     )
 
     for dataset in datasets_list:

@@ -233,12 +233,16 @@ class GPTDolomiteModel(nn.Module):
             hidden_states, ("act_batch", "act_seq", "act_embed")
         )
 
-        # cache length from the first standard KV cache (RNN hybrids mix cache kinds)
+        # cache length from the first standard KV cache (RNN hybrids mix cache kinds);
+        # paged caches ("page_table" present) gather to max_pages * page_size views
         key_length = seq
         if kv_caches is not None:
             for c in kv_caches:
                 if isinstance(c, dict) and "k" in c:
-                    key_length = c["k"].shape[1]
+                    if "page_table" in c:
+                        key_length = c["page_table"].shape[1] * c["k"].shape[1]
+                    else:
+                        key_length = c["k"].shape[1]
                     break
         rope_cos_sin, alibi_bias = compute_position_stuff(
             config,

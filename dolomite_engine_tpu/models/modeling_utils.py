@@ -228,8 +228,16 @@ def update_kv_cache(
 
     `cache_index` is normally a scalar shared by the whole batch. A per-row [B] vector is
     the continuous-batching decode case (serving/engine.py): every slot writes its single
-    new token at its own length, so the validity frontier is per-row too."""
+    new token at its own length, so the validity frontier is per-row too.
+
+    A cache dict carrying a ``page_table`` is a PAGED pool view
+    (serving/kv_cache.PagedKVCachePool): ``k``/``v`` are the shared ``[num_pages,
+    page_size, H, D]`` pools and addressing goes through gather/scatter
+    (`ops/attention.paged_scatter_kv` / `paged_gather_kv`) instead of dense slicing; the
+    returned key/value are contiguous per-row views, so attention downstream is unchanged."""
     seq = key.shape[1]
+    if "page_table" in kv_cache:
+        return _update_paged_kv_cache(key, value, kv_cache, cache_index, attention_mask)
     if getattr(cache_index, "ndim", 0) == 1:
         if seq != 1:
             raise NotImplementedError("per-row cache_index supports single-token decode only")
@@ -248,6 +256,63 @@ def update_kv_cache(
         else attention_mask * valid.astype(attention_mask.dtype)
     )
     return k_cache, v_cache, kv_cache, attention_mask, cache_index
+
+
+def _update_paged_kv_cache(
+    key: jax.Array,
+    value: jax.Array,
+    kv_cache: KVCache,
+    cache_index: jax.Array,
+    attention_mask: jax.Array | None,
+):
+    """Paged-pool variant of `update_kv_cache`: scatter the new tokens into their pages,
+    then gather each row's page list into a contiguous view for attention.
+
+    Write validity comes from `attention_mask` (key-side over the gathered view length):
+    a chunked-prefill bucket's right-pad tail maps to mask-0 positions, and those writes
+    are redirected to the trash page instead of corrupting a real (or unallocated) page.
+    """
+    from ..ops.attention import paged_gather_kv, paged_scatter_kv
+
+    table = kv_cache["page_table"]  # [B, max_pages]
+    page_size = kv_cache["k"].shape[1]
+    batch, seq = key.shape[:2]
+    view_len = table.shape[1] * page_size
+
+    if getattr(cache_index, "ndim", 0) == 1:
+        if seq != 1:
+            raise NotImplementedError("per-row cache_index supports single-token decode only")
+        positions = cache_index[:, None].astype(jnp.int32)  # [B, 1]
+        frontier = cache_index[:, None] + seq  # [B, 1]
+    else:
+        positions = jnp.broadcast_to(
+            (cache_index + jnp.arange(seq, dtype=jnp.int32))[None, :], (batch, seq)
+        )
+        frontier = cache_index + seq  # scalar
+
+    # a prefill chunk's bucket can overhang the view (pad tail past max_len): clamp those
+    # positions for the index math and force their writes to the trash page
+    in_range = positions < view_len
+    positions = jnp.where(in_range, positions, 0)
+    write_valid = in_range
+    if attention_mask is not None:
+        write_valid = write_valid & jnp.take_along_axis(
+            attention_mask.astype(bool), positions, axis=1
+        )
+
+    k_pages = paged_scatter_kv(kv_cache["k"], key, table, positions, write_valid)
+    v_pages = paged_scatter_kv(kv_cache["v"], value, table, positions, write_valid)
+    k_view = paged_gather_kv(k_pages, table)
+    v_view = paged_gather_kv(v_pages, table)
+
+    valid = jnp.arange(view_len)[None, :] < frontier
+    attention_mask = (
+        valid.astype(jnp.int32)
+        if attention_mask is None
+        else attention_mask * valid.astype(attention_mask.dtype)
+    )
+    kv_cache = {"k": k_pages, "v": v_pages, "page_table": table}
+    return k_view, v_view, kv_cache, attention_mask, cache_index
 
 
 class Attention(nn.Module):
@@ -326,7 +391,11 @@ class Attention(nn.Module):
             # q_len == kv_len keeps the Pallas flash path eligible (VERDICT r2 weak #4:
             # prefill previously dragged the full-cache mask through masked sdpa). A traced
             # cache_index (decode, chunked prefill) always takes the full-cache path.
-            static_zero_index = isinstance(cache_index, int) and cache_index == 0
+            static_zero_index = (
+                isinstance(cache_index, int)
+                and cache_index == 0
+                and "page_table" not in kv_cache  # paged writes must go through scatter
+            )
             if seq > 1 and static_zero_index:
                 local_key, local_value = key, value
                 local_mask = None if attention_mask is None else attention_mask[:, :seq]

@@ -69,6 +69,51 @@ def make_attention_mask(
     return mask
 
 
+def paged_scatter_kv(
+    pages: jax.Array,
+    new: jax.Array,
+    page_table: jax.Array,
+    positions: jax.Array,
+    write_valid: jax.Array | None = None,
+) -> jax.Array:
+    """Scatter new K (or V) tokens into a paged pool.
+
+    ``pages`` is the shared pool ``[num_pages, page_size, H, D]``; ``page_table`` maps each
+    row's logical page slots to physical pages ``[B, max_pages]``; ``positions`` are the
+    absolute token positions being written ``[B, S]``. Page 0 is the TRASH page by
+    convention: rows whose table entries are 0 (idle decode slots) and writes with
+    ``write_valid == False`` (right-pad tail of a prefill chunk) land at flat index 0,
+    where collisions are harmless because trash content is never attended unmasked.
+    """
+    num_pages, page_size = pages.shape[:2]
+    batch, seq = positions.shape
+    page_ids = jnp.take_along_axis(page_table, positions // page_size, axis=1)  # [B, S]
+    flat_index = page_ids * page_size + positions % page_size
+    if write_valid is not None:
+        flat_index = jnp.where(write_valid, flat_index, 0)
+    flat_pages = pages.reshape((num_pages * page_size,) + pages.shape[2:])
+    flat_pages = flat_pages.at[flat_index.reshape(-1)].set(
+        new.reshape((batch * seq,) + new.shape[2:])
+    )
+    return flat_pages.reshape(pages.shape)
+
+
+def paged_gather_kv(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Gather each row's pages into a contiguous ``[B, max_pages * page_size, H, D]`` view.
+
+    Positions past a row's validity frontier read whatever the mapped page holds (stale
+    K/V, trash) — finite garbage the attention mask reduces to exactly-zero probability,
+    so downstream attention is bitwise identical to a dense cache with the same frontier.
+    """
+    num_pages, page_size = pages.shape[:2]
+    batch, max_pages = page_table.shape
+    flat_pages = pages.reshape((num_pages * page_size,) + pages.shape[2:])
+    index = (
+        page_table[:, :, None] * page_size + jnp.arange(page_size, dtype=page_table.dtype)
+    ).reshape(batch, max_pages * page_size)
+    return flat_pages[index]
+
+
 def _repeat_kv(k: jax.Array, num_query_heads: int) -> jax.Array:
     """Expand KV heads to match query heads (reference `attention/utils.py` repeat_key_value)."""
     num_kv = k.shape[2]

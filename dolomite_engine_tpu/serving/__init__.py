@@ -1,9 +1,14 @@
 from .engine import EngineStats, ServingEngine, serve_batch
-from .kv_cache import SlotKVCachePool
+from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
+from .prefix_cache import PrefixCache, PrefixMatch, PrefixNode
 from .scheduler import QueueFullError, Request, RequestState, RequestStatus, SamplingParams, Scheduler
 
 __all__ = [
     "EngineStats",
+    "PagedKVCachePool",
+    "PrefixCache",
+    "PrefixMatch",
+    "PrefixNode",
     "QueueFullError",
     "Request",
     "RequestState",
@@ -12,5 +17,6 @@ __all__ = [
     "Scheduler",
     "ServingEngine",
     "SlotKVCachePool",
+    "TRASH_PAGE",
     "serve_batch",
 ]

@@ -1,29 +1,35 @@
-"""Continuous-batching serving engine: one jitted decode step over a slot pool.
+"""Continuous-batching serving engine: one jitted decode step over a paged KV pool.
 
 The legacy path (`generation_utils.generate_tokens`) is one-shot: a batch arrives
 together, shares one set of python-static sampling params, and stalls until its slowest
 row finishes. This engine is the Orca/vLLM-style fix with fully static shapes:
 
-- **prefill** runs per request through length-bucketed jitted functions (right-padded to
-  the bucket so the prompt occupies cache positions ``[0, len)``), writes K/V into the
-  request's slot of a :class:`~dolomite_engine_tpu.serving.kv_cache.SlotKVCachePool`, and
-  samples the first token (that's TTFT);
+- the KV cache is a **paged pool** by default (`kv_cache.PagedKVCachePool`): fixed-size
+  pages shared across slots, per-slot page tables threaded through the jitted decode
+  step, HBM scaling with resident tokens instead of ``num_slots * max_len``
+  (``paged=False`` keeps the PR-4 dense slot pool for A/B);
+- **prefix caching** (`prefix_cache.PrefixCache`): page-aligned prompt prefixes that are
+  already resident are shared read-only (refcounted) instead of re-prefilled; a partially
+  matching tail page is copied at page granularity (COW) and only the miss suffix is
+  computed;
+- **prefill is chunked**: prompts are computed `prefill_chunk_tokens` at a time
+  (scheduler knob), interleaved with decode steps, so a long arrival no longer stalls the
+  inter-token latency of running requests;
 - **decode** is a single jitted step over the whole ``[num_slots]`` batch — per-slot
-  cache positions (vector ``cache_index``), per-slot RNG streams, and per-slot
-  **traced** sampling params (`ops/sampling.sample_tokens_vectorized`), so one compiled
-  program serves any mix of greedy/temperature/top-k/top-p requests and compiles exactly
-  once for the lifetime of the engine;
+  cache positions, page-table rows, RNG streams, and per-slot **traced** sampling params
+  (`ops/sampling.sample_tokens_vectorized`), so one compiled program serves any request
+  mix and compiles exactly once for the lifetime of the engine;
 - the **scheduler** admits waiting requests into freed slots at every step boundary
-  (FCFS, bounded queue, wall-clock deadlines) — a finished row's slot is reused next
-  step instead of stalling the batch.
+  (FCFS, bounded queue, wall-clock deadlines), page-availability-aware in paged mode.
 
 Tokens stream out through per-request callbacks the moment the host sees them (one
 device->host sync per step — the price of streaming and EOS detection, identical to the
 legacy path's end-of-call fetch amortized over steps).
 
 Numerics: a request decoded through the engine reproduces an equivalent single-request
-`generate_tokens` call token-for-token (same per-step RNG split discipline, same
-processor encodings; see tests/test_serving.py for the bit-exact parity suite).
+`generate_tokens` call token-for-token — with the paged pool, prefix hits, and chunked
+prefill all active (same per-step RNG split discipline, same processor encodings; see
+tests/test_serving.py + tests/test_serving_paged.py for the bit-exact parity suites).
 """
 
 from __future__ import annotations
@@ -38,7 +44,8 @@ import numpy as np
 
 from ..ops.sampling import sample_tokens_vectorized
 from ..utils.telemetry import get_telemetry
-from .kv_cache import SlotKVCachePool
+from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
+from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import (
     Request,
     RequestState,
@@ -55,10 +62,12 @@ class EngineStats:
     """Cumulative host-side accounting: rates for telemetry records and the bench harness.
 
     `prefill_seconds`/`decode_seconds` are wall time inside the respective jitted calls
-    (including the host fetch that forces completion); token counts are prompt tokens
-    prefilled and tokens emitted by decode steps. The first token of each request is
-    sampled inside prefill — it shows up in `ttft_s` samples, not in either rate.
-    Cumulative over the engine's lifetime, like the telemetry window counters.
+    (including the host fetch that forces completion); `prefill_tokens` counts prompt
+    tokens actually COMPUTED (prefix-cache hits are skipped work and show up in
+    `prefix_hit_tokens` instead); `decode_tokens` counts tokens emitted by decode steps.
+    The first token of each request is sampled inside prefill — it shows up in `ttft_s`
+    samples, not in either rate. Cumulative over the engine's lifetime, like the
+    telemetry window counters.
     """
 
     prefill_seconds: float = 0.0
@@ -71,6 +80,9 @@ class EngineStats:
     completed: int = 0
     rejected: int = 0
     cancelled: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_miss_tokens: int = 0
+    peak_active: int = 0
 
     def prefill_tok_s(self) -> float | None:
         if self.prefill_seconds <= 0:
@@ -87,6 +99,21 @@ class EngineStats:
             return None
         return sum(self.ttft_s) / len(self.ttft_s)
 
+    def prefix_hit_rate(self) -> float | None:
+        total = self.prefix_hit_tokens + self.prefix_miss_tokens
+        if total == 0:
+            return None
+        return self.prefix_hit_tokens / total
+
+
+@dataclass
+class _PrefillTask:
+    """A slot whose prompt is still being computed (chunked prefill in flight)."""
+
+    state: RequestState
+    encoded: tuple  # (do_sample, temperature, top_k, top_p) dense encoding
+    pos: int  # next prompt position to compute (prefix-cache hits start it past 0)
+
 
 class ServingEngine:
     """Drive a decoder-only dolomite model as a continuously-batched token service.
@@ -98,13 +125,21 @@ class ServingEngine:
         num_slots: decode batch width == max concurrent requests.
         max_len: per-slot cache length; every request needs
             ``len(prompt) + max_new_tokens <= max_len``.
-        prefill_bucket_multiple: prompts are right-padded to the next multiple for the
-            bucketed prefill jit (one compile per distinct bucket).
+        prefill_bucket_multiple: prompts (paged: prefill chunks) are right-padded to the
+            next multiple for the bucketed prefill jit (one compile per distinct bucket).
         max_waiting: waiting-queue bound; `submit` raises
             :class:`~dolomite_engine_tpu.serving.scheduler.QueueFullError` beyond it.
         eos_token_id / pad_token_id: engine defaults (per-request eos override on submit).
         record_interval: emit a ``serving`` telemetry record every N decode steps
             (0 = only on :meth:`drain`).
+        paged: use the paged KV pool (default) or the dense PR-4 slot pool.
+        page_size: tokens per KV page (positive multiple of 8).
+        num_pages: physical pages in the pool (page 0 is reserved as trash). Default
+            matches the dense pool's capacity; set it to your HBM budget to oversubscribe
+            slots — admission reserves worst-case pages so decode can never run out.
+        prefill_chunk_tokens: per-step prefill token budget (positive multiple of 8).
+        prefix_caching: keep finished requests' page-aligned prefixes resident and share
+            them with matching future prompts (paged mode only).
     """
 
     def __init__(
@@ -122,6 +157,11 @@ class ServingEngine:
         rng: jax.Array | None = None,
         record_interval: int = 0,
         clock=time.monotonic,
+        paged: bool = True,
+        page_size: int = 16,
+        num_pages: int | None = None,
+        prefill_chunk_tokens: int = 512,
+        prefix_caching: bool = True,
     ) -> None:
         if prefill_bucket_multiple <= 0 or prefill_bucket_multiple % 8 != 0:
             raise ValueError(
@@ -140,9 +180,19 @@ class ServingEngine:
         self.cache_dtype = cache_dtype
         self.prefill_bucket_multiple = prefill_bucket_multiple
         self.record_interval = record_interval
+        self.paged = paged
 
-        self.pool = SlotKVCachePool(model, num_slots, max_len, cache_dtype)
-        self.scheduler = Scheduler(max_waiting=max_waiting, clock=clock)
+        if paged:
+            self.pool: Any = PagedKVCachePool(
+                model, num_slots, max_len, page_size, num_pages, cache_dtype
+            )
+            self.prefix = PrefixCache(page_size) if prefix_caching else None
+        else:
+            self.pool = SlotKVCachePool(model, num_slots, max_len, cache_dtype)
+            self.prefix = None
+        self.scheduler = Scheduler(
+            max_waiting=max_waiting, clock=clock, prefill_chunk_tokens=prefill_chunk_tokens
+        )
         self.stats = EngineStats()
         self._step_count = 0
         self._last_record_step = 0
@@ -158,11 +208,17 @@ class ServingEngine:
         self._top_k = np.zeros(num, np.int32)
         self._top_p = np.ones(num, np.float32)
         self._slot_states: dict[int, RequestState] = {}
+        # chunked prefill in flight (paged mode): FCFS order + per-slot progress
+        self._prefill_tasks: dict[int, _PrefillTask] = {}
+        self._prefill_order: list[int] = []
 
-        self._prefill_fns: dict[int, Any] = {}
+        self._prefill_fns: dict[int, Any] = {}  # dense mode: whole-prompt bucket -> jit
+        self._chunk_fns: dict[tuple[int, bool], Any] = {}  # paged: (width, final) -> jit
         # donate the cache pool: decode rewrites it in place instead of copying
-        # [layers, num_slots, max_len] of K/V every step
-        self._decode_step = jax.jit(self._decode_impl, donate_argnums=(1,))
+        # [layers, num_slots, max_len] (dense) / [layers, num_pages, page_size] (paged)
+        # of K/V every step
+        decode_impl = self._decode_impl_paged if paged else self._decode_impl
+        self._decode_step = jax.jit(decode_impl, donate_argnums=(1,))
 
     # ------------------------------------------------------------------ jitted programs
 
@@ -180,6 +236,27 @@ class ServingEngine:
             logits, split[:, 1], do_sample, temperature, top_k, top_p
         )
         return out.kv_caches, next_tokens, split[:, 0]
+
+    def _decode_impl_paged(
+        self, variables, caches, page_table, tokens, lengths, rngs, do_sample, temperature, top_k, top_p
+    ):
+        # one shared [S, max_pages] table serves every layer; rows of slots that are idle
+        # or mid-prefill are zeroed by the host, so their garbage token lands in trash
+        kv = [{"k": c["k"], "v": c["v"], "page_table": page_table} for c in caches]
+        out = self.model.apply(
+            variables,
+            tokens[:, None],
+            position_ids=lengths[:, None],
+            kv_caches=kv,
+            cache_index=lengths,
+        )
+        logits = out.logits[:, -1]
+        split = jax.vmap(jax.random.split)(rngs)
+        next_tokens = sample_tokens_vectorized(
+            logits, split[:, 1], do_sample, temperature, top_k, top_p
+        )
+        new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
+        return new_caches, next_tokens, split[:, 0]
 
     def _get_prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -213,6 +290,44 @@ class ServingEngine:
             fn = self._prefill_fns[bucket] = jax.jit(prefill)
         return fn
 
+    def _get_chunk_fn(self, width: int, final: bool):
+        """Chunked-prefill program for one chunk bucket width: scatter the chunk's K/V
+        into the slot's pages (pad tail -> trash) while attending causally over the whole
+        resident prefix. The FINAL chunk additionally samples the request's first token
+        with the same rng-split discipline as `generate_tokens` prefill."""
+        key = (width, final)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+
+            def chunk(variables, caches, table_row, ids, mask, start, num_real, rng, do_sample, temperature, top_k, top_p):
+                kv = [{"k": c["k"], "v": c["v"], "page_table": table_row} for c in caches]
+                position_ids = (start + jnp.arange(width, dtype=jnp.int32))[None, :]
+                out = self.model.apply(
+                    variables,
+                    ids,
+                    position_ids=position_ids,
+                    attention_mask=mask,
+                    kv_caches=kv,
+                    cache_index=start,
+                )
+                new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
+                if not final:
+                    return new_caches
+                last = jax.lax.dynamic_slice_in_dim(out.logits, num_real - 1, 1, axis=1)[:, 0]
+                carry, step_rng = jax.random.split(rng)
+                token = sample_tokens_vectorized(
+                    last,
+                    step_rng[None],
+                    do_sample[None],
+                    temperature[None],
+                    top_k[None],
+                    top_p[None],
+                )
+                return new_caches, token[0], carry
+
+            fn = self._chunk_fns[key] = jax.jit(chunk, donate_argnums=(1,))
+        return fn
+
     # ------------------------------------------------------------------ submission
 
     def submit(
@@ -238,6 +353,13 @@ class ServingEngine:
                 f"request needs {len(prompt_ids)} prompt + {max_new_tokens} new tokens "
                 f"> max_len={self.pool.max_len}"
             )
+        if self.paged:
+            worst_pages = -(-(len(prompt_ids) + max_new_tokens) // self.pool.page_size)
+            if worst_pages > self.pool.num_pages - 1:
+                raise ValueError(
+                    f"request needs {worst_pages} page(s) worst-case but the pool has "
+                    f"{self.pool.num_pages - 1} allocatable page(s)"
+                )
         if rng is None:
             self._base_rng, rng = jax.random.split(self._base_rng)
         request = Request(
@@ -265,12 +387,19 @@ class ServingEngine:
 
     def step(self) -> bool:
         """One scheduler iteration: reap deadline-expired slots, admit waiting requests
-        into free slots (prefill), run one decode step over the slot batch. Returns
-        whether any work remains."""
+        into free slots, advance chunked prefills up to the budget (paged mode), run one
+        decode step over the slot batch. Returns whether any work remains."""
         self._cancel_expired_running()
-        self._admit()
-        if self._slot_states:
-            self._decode_once()
+        if self.paged:
+            self._admit_paged()
+            self._run_prefill_chunks()
+            if any(slot not in self._prefill_tasks for slot in self._slot_states):
+                self._decode_once_paged()
+        else:
+            self._admit()
+            if self._slot_states:
+                self._decode_once()
+        self.stats.peak_active = max(self.stats.peak_active, self.pool.num_active)
         if (
             self.record_interval
             and self._step_count - self._last_record_step >= self.record_interval
@@ -289,7 +418,7 @@ class ServingEngine:
         """Number of compiled decode-step variants (the static-shape invariant: 1)."""
         return int(self._decode_step._cache_size())
 
-    # ------------------------------------------------------------------ internals
+    # ------------------------------------------------------------------ dense internals
 
     def _admit(self) -> None:
         admit, dead = self.scheduler.admissible(self.pool.num_free)
@@ -367,7 +496,189 @@ class ServingEngine:
         self._step_count += 1
         self.stats.decode_steps += 1
         self.stats.decode_seconds += time.perf_counter() - t0
+        self._emit_decoded(active, tokens)
 
+    # ------------------------------------------------------------------ paged internals
+
+    def _admit_paged(self) -> None:
+        """Admit FCFS while slot rows AND pages are available. Worst-case pages
+        (minus prefix-cache hits) are reserved up front so a mid-decode page allocation
+        can never fail; prefix-cache-only pages are evicted LRU to make room."""
+        while self.pool.num_free > 0:
+            state = self.scheduler.pop_next()
+            if state is None:
+                return
+            if self.scheduler.expired(state):
+                self._finish(state, RequestStatus.cancelled)
+                continue
+            request = state.request
+            prompt_len = len(request.prompt_ids)
+            page_size = self.pool.page_size
+            worst_pages = -(-(prompt_len + request.max_new_tokens) // page_size)
+            if self.prefix is not None:
+                match = self.prefix.match(request.prompt_ids)
+            else:
+                match = PrefixMatch(nodes=[], cow=None, cow_len=0, resume_pos=0)
+            # attach the hit pages FIRST (refcount 2: index + slot) and pin the COW donor,
+            # so the eviction pass below can never reclaim the pages we are about to use
+            slot = self.pool.allocate()
+            for i, node in enumerate(match.nodes):
+                self.pool.attach_shared(slot, i, node.page)
+            if match.cow is not None:
+                self.pool.incref(match.cow.page)
+
+            needed = worst_pages - len(match.nodes)
+            shortfall = needed - self.pool.available_pages
+            if shortfall > 0 and self.prefix is not None:
+                self.prefix.evict(shortfall, self.pool)
+            if needed > self.pool.available_pages:
+                # not enough pages yet: roll back (free decrefs the attached hit pages)
+                # and wait at the queue head — FCFS, requests never skip ahead
+                if match.cow is not None:
+                    self.pool.decref(match.cow.page)
+                self.pool.free(slot)
+                self.scheduler.push_front(state)
+                return
+
+            self.pool.reserve(slot, needed)
+            if match.cow is not None:
+                # copy-on-write at page granularity: the partially matching tail page is
+                # device-copied into a private page; the miss suffix is recomputed over it
+                dst = self.pool.alloc_page(slot, len(match.nodes))
+                self.pool.copy_page(match.cow.page, dst)
+                self.pool.decref(match.cow.page)
+
+            do_sample, temperature, top_k, top_p = request.sampling.encoded()
+            state.slot = slot
+            state.status = RequestStatus.running
+            self._slot_states[slot] = state
+            self._do_sample[slot] = do_sample
+            self._temperature[slot] = temperature
+            self._top_k[slot] = top_k
+            self._top_p[slot] = top_p
+            self._prefill_tasks[slot] = _PrefillTask(
+                state=state,
+                encoded=(do_sample, temperature, top_k, top_p),
+                pos=match.resume_pos,
+            )
+            self._prefill_order.append(slot)
+
+            hit = match.resume_pos
+            self.stats.prefix_hit_tokens += hit
+            self.stats.prefix_miss_tokens += prompt_len - hit
+            self.stats.admitted += 1
+            get_telemetry().count("serving_requests_admitted")
+            if hit:
+                get_telemetry().count("serving_prefix_hit_tokens", hit)
+            get_telemetry().count("serving_prefix_miss_tokens", prompt_len - hit)
+
+    def _run_prefill_chunks(self) -> None:
+        """Advance in-flight prefills FCFS, spending at most the scheduler's
+        `prefill_chunk_tokens` budget of REAL prompt tokens this step — decode for
+        already-running slots resumes right after, so their ITL stays bounded no matter
+        how long the arriving prompt is."""
+        budget = self.scheduler.prefill_chunk_tokens
+        page_size = self.pool.page_size
+        view_len = self.pool.max_pages_per_slot * page_size
+        while budget > 0 and self._prefill_order:
+            slot = self._prefill_order[0]
+            task = self._prefill_tasks[slot]
+            state = task.state
+            prompt = state.request.prompt_ids
+            prompt_len = len(prompt)
+            take = min(prompt_len - task.pos, budget)
+            final = task.pos + take == prompt_len
+            multiple = self.prefill_bucket_multiple
+            width = -(-take // multiple) * multiple
+
+            # map fresh pages under the chunk's real positions before the device write
+            for index in range(task.pos // page_size, (task.pos + take - 1) // page_size + 1):
+                if self.pool.page_table[slot, index] == TRASH_PAGE:
+                    self.pool.alloc_page(slot, index)
+
+            ids = np.full((1, width), self.pad_token_id, np.int32)
+            ids[0, :take] = prompt[task.pos : task.pos + take]
+            mask = np.zeros((1, view_len), np.int32)
+            mask[0, : task.pos + take] = 1  # resident prefix + this chunk's real tokens
+
+            do_sample, temperature, top_k, top_p = task.encoded
+            t0 = time.perf_counter()
+            result = self._get_chunk_fn(width, final)(
+                self._variables,
+                self.pool.caches,
+                jnp.asarray(self.pool.page_table[slot : slot + 1]),
+                jnp.asarray(ids),
+                jnp.asarray(mask),
+                jnp.asarray(task.pos, jnp.int32),
+                jnp.asarray(take, jnp.int32),
+                state.request.rng,
+                jnp.asarray(do_sample),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_k, jnp.int32),
+                jnp.asarray(top_p, jnp.float32),
+            )
+            if final:
+                self.pool.caches, token, carry = result
+                first_token = int(token)  # host fetch: ends the TTFT clock
+            else:
+                self.pool.caches = result
+                jax.block_until_ready(self.pool.caches[0]["k"])
+            self.stats.prefill_seconds += time.perf_counter() - t0
+            self.stats.prefill_tokens += take
+            get_telemetry().count("serving_prefill_tokens", take)
+            task.pos += take
+            budget -= take
+
+            if final:
+                self.pool.lengths[slot] = prompt_len
+                state.first_token_t = self.scheduler.clock()
+                if state.ttft_s is not None:
+                    self.stats.ttft_s.append(state.ttft_s)
+                self._tokens[slot] = first_token
+                self._rngs[slot] = np.array(carry)
+                self._prefill_order.pop(0)
+                del self._prefill_tasks[slot]
+                self._deliver(state, first_token)
+
+    def _decode_once_paged(self) -> None:
+        decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
+        page_size = self.pool.page_size
+        # per-step table/length views: idle and mid-prefill rows are zeroed so their
+        # garbage write lands in the trash page instead of live pages
+        table = np.zeros_like(self.pool.page_table)
+        lengths = np.zeros(self.pool.num_slots, np.int32)
+        for slot in decoding:
+            position = int(self.pool.lengths[slot])
+            index = position // page_size
+            if self.pool.page_table[slot, index] == TRASH_PAGE:
+                self.pool.alloc_page(slot, index)  # reservation makes this infallible
+            table[slot] = self.pool.page_table[slot]
+            lengths[slot] = position
+
+        t0 = time.perf_counter()
+        caches, next_tokens, new_rngs = self._decode_step(
+            self._variables,
+            self.pool.caches,
+            jnp.asarray(table),
+            jnp.asarray(self._tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(self._rngs),
+            jnp.asarray(self._do_sample),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+        )
+        self.pool.caches = caches
+        tokens = np.asarray(next_tokens)  # host fetch: the streaming sync point
+        self._rngs = np.array(new_rngs)
+        self._step_count += 1
+        self.stats.decode_steps += 1
+        self.stats.decode_seconds += time.perf_counter() - t0
+        self._emit_decoded(decoding, tokens)
+
+    # ------------------------------------------------------------------ shared internals
+
+    def _emit_decoded(self, active: list[int], tokens: np.ndarray) -> None:
         emitted = 0
         for slot in active:
             state = self._slot_states.get(slot)
@@ -403,8 +714,14 @@ class ServingEngine:
         state.status = status
         state.finish_t = self.scheduler.clock()
         if state.slot is not None:
-            self.pool.free(state.slot)
-            del self._slot_states[state.slot]
+            slot = state.slot
+            self._prefill_tasks.pop(slot, None)
+            if slot in self._prefill_order:
+                self._prefill_order.remove(slot)
+            if self.prefix is not None:
+                self._register_prefix(state, slot)
+            self.pool.free(slot)
+            del self._slot_states[slot]
         if status == RequestStatus.completed:
             self.stats.completed += 1
             get_telemetry().count("serving_requests_completed")
@@ -414,16 +731,34 @@ class ServingEngine:
         if state.request.on_finish is not None:
             state.request.on_finish(state)
 
+    def _register_prefix(self, state: RequestState, slot: int) -> None:
+        """Index the slot's full pages before they are released: generated tokens are
+        registered too, so a multi-turn follow-up whose prompt embeds this reply hits."""
+        written = int(self.pool.lengths[slot])
+        if written <= 0:
+            return  # cancelled mid-prefill: nothing committed
+        prompt = state.request.prompt_ids
+        resident = prompt + state.tokens[: written - len(prompt)]
+        self.prefix.register(
+            resident[:written], [int(p) for p in self.pool.page_table[slot]], self.pool
+        )
+
     # ------------------------------------------------------------------ telemetry
 
     def emit_serving_record(self) -> None:
-        """Write one ``serving`` telemetry record — instantaneous queue/slot state plus
-        cumulative rates and counters (no-op sink when no telemetry is installed)."""
+        """Write one ``serving`` telemetry record — instantaneous queue/slot/page state
+        plus cumulative rates and counters (no-op sink when no telemetry is installed)."""
         telemetry = get_telemetry()
         stats = self.stats
         self._last_record_step = self._step_count
         telemetry.gauge("serving/queue_depth", self.scheduler.queue_depth)
         telemetry.gauge("serving/slot_occupancy", self.pool.occupancy)
+        pages_in_use = fragmentation = None
+        if self.paged:
+            pages_in_use = self.pool.pages_in_use
+            fragmentation = round(self.pool.page_fragmentation, 4)
+            telemetry.gauge("serving/pages_in_use", pages_in_use)
+            telemetry.gauge("serving/page_fragmentation", fragmentation)
         ttft = stats.mean_ttft_s()
         prefill_rate = stats.prefill_tok_s()
         decode_rate = stats.decode_tok_s()
@@ -433,6 +768,9 @@ class ServingEngine:
             queue_depth=self.scheduler.queue_depth,
             slots_active=self.pool.num_active,
             num_slots=self.pool.num_slots,
+            pages_in_use=pages_in_use,
+            pages_total=self.pool.num_pages - 1 if self.paged else None,
+            page_fragmentation=fragmentation,
             ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
             prefill_tok_s=None if prefill_rate is None else round(prefill_rate, 1),
             decode_tok_s=None if decode_rate is None else round(decode_rate, 1),
@@ -444,6 +782,8 @@ class ServingEngine:
                 "prefill_tokens": stats.prefill_tokens,
                 "decode_tokens": stats.decode_tokens,
                 "decode_steps": stats.decode_steps,
+                "prefix_hit_tokens": stats.prefix_hit_tokens,
+                "prefix_miss_tokens": stats.prefix_miss_tokens,
             },
         )
 

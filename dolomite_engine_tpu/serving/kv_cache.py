@@ -1,17 +1,22 @@
-"""Slot-based KV cache pool: static-shape cache memory for continuous batching.
+"""KV cache pools for continuous batching: dense slot rows or a shared paged pool.
 
-One preallocated ``[num_slots, max_len, kv_heads, head_dim]`` cache per layer (the same
-layout `model.init_kv_caches` produces for a fixed batch), plus host-side slot
-bookkeeping: a free list, per-slot length tracking, and reclamation on finish. The decode
-program only ever sees the full ``[num_slots, ...]`` arrays, so its shapes never change —
-requests come and go by overwriting slot rows, never by reshaping (the TPU-native
-equivalent of vLLM's block tables: one block per request, sized for the longest
-admissible sequence, traded against PagedAttention's fragmentation wins for a program
-that compiles exactly once).
+:class:`SlotKVCachePool` (the PR-4 design, kept as the ``paged=False`` baseline) holds one
+preallocated ``[num_slots, max_len, kv_heads, head_dim]`` cache per layer — HBM scales
+with the worst-case length of every slot, which caps concurrency long before compute does.
 
-Slot hygiene relies on masking, not zeroing: a freed slot keeps its stale K/V, and the
-next occupant's prefill overwrites ``[0, bucket)`` while the per-row validity frontier
-(``update_kv_cache``'s `arange < length + 1` mask) hides everything it hasn't written.
+:class:`PagedKVCachePool` is the PagedAttention-style fix (vLLM, Kwon et al. 2023) with
+TPU-friendly static shapes: a fixed set of fixed-size pages (``[num_pages, page_size,
+kv_heads, head_dim]`` per layer) shared across slots, per-slot page tables
+(``[num_slots, max_pages]`` int32) threaded through the jitted decode step, and
+gather/scatter addressing (`ops/attention.paged_gather_kv` / `paged_scatter_kv`) inside
+``models/modeling_utils.update_kv_cache``. HBM now scales with tokens actually resident,
+not with ``num_slots * max_len``; refcounted pages make prefix sharing
+(serving/prefix_cache.py) a pure bookkeeping operation.
+
+Page hygiene mirrors the dense pool's masking discipline: freed pages keep their stale
+K/V and the per-row validity frontier hides everything not yet written. **Page 0 is the
+trash page** — never allocated, the scatter target for idle decode rows and prefill-chunk
+pad tails, so garbage writes can never corrupt live data.
 """
 
 from __future__ import annotations
@@ -23,9 +28,11 @@ import numpy as np
 
 KVCacheList = list[Any]  # per-layer {"k": [S, L, H, D], "v": ...} (models/modeling_utils)
 
+TRASH_PAGE = 0  # page-table sentinel: unmapped logical page / garbage-write target
+
 
 class SlotKVCachePool:
-    """Fixed pool of `num_slots` cache rows of `max_len` tokens each.
+    """Fixed pool of `num_slots` dense cache rows of `max_len` tokens each.
 
     The device arrays live in `self.caches` (a per-layer list, threaded through the
     jitted decode step and reassigned from its output); allocation state lives on host.
@@ -42,7 +49,10 @@ class SlotKVCachePool:
         # number of valid cache entries per slot (prompt + generated-and-written tokens);
         # 0 for free slots, so an idle slot's decode row masks down to its own garbage token
         self.lengths = np.zeros(num_slots, np.int32)
-        self._insert_fn = None
+        # explicit per-shape jit cache, keyed by the prefill operand's bucket width (the
+        # slot index itself is traced, so slots don't multiply compilations) — the same
+        # pattern as the engine's `_prefill_fns`
+        self._insert_fns: dict[int, Any] = {}
 
     # ------------------------------------------------------------------ allocation
 
@@ -87,11 +97,11 @@ class SlotKVCachePool:
         if slot not in self._in_use:
             raise ValueError(f"slot {slot} is not allocated")
         assert 0 < length <= self.max_len, (length, self.max_len)
-        if self._insert_fn is None:
-            # jitted once per prefill bucket width (the update operand's static shape);
-            # the slot index itself is traced, so slots don't multiply compilations
-            self._insert_fn = jax.jit(_insert_slot)
-        self.caches = self._insert_fn(self.caches, prefill_caches, slot)
+        bucket = prefill_caches[0]["k"].shape[1]
+        insert_fn = self._insert_fns.get(bucket)
+        if insert_fn is None:
+            insert_fn = self._insert_fns[bucket] = jax.jit(_insert_slot)
+        self.caches = insert_fn(self.caches, prefill_caches, slot)
         self.lengths[slot] = length
 
 
@@ -105,3 +115,183 @@ def _insert_slot(pool_caches: KVCacheList, prefill_caches: KVCacheList, slot) ->
             }
         )
     return out
+
+
+class PagedKVCachePool:
+    """Shared page pool + per-slot page tables, with refcounts and admission reservations.
+
+    Host-side invariants the engine and prefix cache rely on:
+
+    - page 0 (:data:`TRASH_PAGE`) is never allocated; a page-table entry of 0 means "not
+      mapped" and any device write through it lands in trash;
+    - a page is writable by a slot only while that slot holds its sole reference
+      (``refcounts == 1`` and not retained by the prefix index) — shared pages are
+      read-only and the engine copies the partial tail page before writing (COW);
+    - ``len(free pages) >= total reserved`` at all times: admission reserves the
+      worst-case page count up front (`reserve`), every later `alloc_page` for that slot
+      consumes the reservation, so a mid-decode allocation can never fail and the decode
+      step never deadlocks on pages.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        num_slots: int,
+        max_len: int,
+        page_size: int,
+        num_pages: int | None = None,
+        dtype=None,
+    ) -> None:
+        assert num_slots > 0 and max_len > 0, (num_slots, max_len)
+        if page_size <= 0 or page_size % 8 != 0:
+            raise ValueError(f"page_size must be a positive multiple of 8, got {page_size}")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.page_size = page_size
+        self.max_pages_per_slot = -(-max_len // page_size)
+        if num_pages is None:
+            # dense-parity capacity by default (plus the trash page): the paged pool is
+            # never WORSE than the dense pool; savings come from setting num_pages to the
+            # actual HBM budget instead
+            num_pages = 1 + num_slots * self.max_pages_per_slot
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is the trash page), got {num_pages}")
+        self.num_pages = num_pages
+
+        # pages, not slot rows: [num_pages, page_size, H, D] per layer — same
+        # init_kv_caches layout with "batch" = pages and "length" = page_size
+        self.caches: KVCacheList = model.init_kv_caches(num_pages, page_size, dtype)
+        self.page_table = np.zeros((num_slots, self.max_pages_per_slot), np.int32)
+        self.lengths = np.zeros(num_slots, np.int32)
+        self.refcounts = np.zeros(num_pages, np.int32)
+
+        self._free_slots: list[int] = list(reversed(range(num_slots)))
+        self._slots_in_use: set[int] = set()
+        self._free_pages: list[int] = list(reversed(range(1, num_pages)))  # page 0 = trash
+        self._slot_reserved = np.zeros(num_slots, np.int32)
+        self._total_reserved = 0
+        self._copy_fn = None  # single shape (traced src/dst), so a plain cached jit is exact
+
+    # ------------------------------------------------------------------ slot API (engine)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._slots_in_use)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._slots_in_use) / self.num_slots
+
+    def allocate(self) -> int | None:
+        """Claim a free slot row (lowest index first), or None when all rows are taken."""
+        if not self._free_slots:
+            return None
+        slot = self._free_slots.pop()
+        self._slots_in_use.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Release a slot: decref every mapped page, clear the table row, return the
+        unused reservation. Pages whose refcount hits zero go back on the free list
+        (stale content stays, masked, exactly like the dense pool's slot hygiene)."""
+        if slot not in self._slots_in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        for i in range(self.max_pages_per_slot):
+            page = int(self.page_table[slot, i])
+            if page != TRASH_PAGE:
+                self.decref(page)
+            self.page_table[slot, i] = TRASH_PAGE
+        self._slots_in_use.remove(slot)
+        self._free_slots.append(slot)
+        self.lengths[slot] = 0
+        self._total_reserved -= int(self._slot_reserved[slot])
+        self._slot_reserved[slot] = 0
+
+    # ------------------------------------------------------------------ page accounting
+
+    @property
+    def pages_in_use(self) -> int:
+        """Physical pages currently referenced (by slots and/or the prefix index)."""
+        return (self.num_pages - 1) - len(self._free_pages)
+
+    @property
+    def available_pages(self) -> int:
+        """Free pages not promised to an admitted slot — what admission may spend."""
+        return len(self._free_pages) - self._total_reserved
+
+    @property
+    def page_fragmentation(self) -> float:
+        """Fraction of allocated page capacity not holding valid tokens (the partial tail
+        page of each slot; shared/index pages are always full). Approximate during a
+        chunked prefill — the slot's length is only committed at prefill completion."""
+        in_use = self.pages_in_use
+        if in_use == 0:
+            return 0.0
+        wasted = 0
+        for slot in self._slots_in_use:
+            length = int(self.lengths[slot])
+            if length > 0 and length % self.page_size:
+                wasted += self.page_size - (length % self.page_size)
+        return wasted / (in_use * self.page_size)
+
+    def reserve(self, slot: int, pages: int) -> None:
+        """Promise `pages` future allocations to `slot` (worst-case minus prefix hits,
+        checked against `available_pages` by the caller before admission)."""
+        assert pages >= 0, pages
+        if pages > self.available_pages:
+            raise ValueError(
+                f"cannot reserve {pages} page(s): only {self.available_pages} available"
+            )
+        self._slot_reserved[slot] += pages
+        self._total_reserved += pages
+
+    def alloc_page(self, slot: int, index: int) -> int:
+        """Map a fresh private page (refcount 1) at logical page slot `index`, consuming
+        one unit of the slot's reservation — which is what makes this infallible."""
+        assert self.page_table[slot, index] == TRASH_PAGE, (slot, index)
+        assert self._slot_reserved[slot] > 0, f"slot {slot} has no reserved pages left"
+        page = self._free_pages.pop()
+        self.refcounts[page] = 1
+        self.page_table[slot, index] = page
+        self._slot_reserved[slot] -= 1
+        self._total_reserved -= 1
+        return page
+
+    def attach_shared(self, slot: int, index: int, page: int) -> None:
+        """Map an existing page (a prefix-cache hit) read-only into `slot` at `index`."""
+        assert self.page_table[slot, index] == TRASH_PAGE, (slot, index)
+        assert page != TRASH_PAGE and self.refcounts[page] > 0, page
+        self.refcounts[page] += 1
+        self.page_table[slot, index] = page
+
+    def incref(self, page: int) -> None:
+        assert page != TRASH_PAGE and self.refcounts[page] > 0, page
+        self.refcounts[page] += 1
+
+    def decref(self, page: int) -> None:
+        assert page != TRASH_PAGE, "decref on the trash page"
+        if self.refcounts[page] <= 0:
+            raise ValueError(f"page {page} double-freed (refcount {self.refcounts[page]})")
+        self.refcounts[page] -= 1
+        if self.refcounts[page] == 0:
+            self._free_pages.append(page)
+
+    # ------------------------------------------------------------------ device ops
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device-copy page `src` onto page `dst` in every layer (the COW step for a
+        partially-shared tail page). Indices are traced, so this compiles once."""
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(_copy_page, donate_argnums=(0,))
+        self.caches = self._copy_fn(self.caches, src, dst)
+
+
+def _copy_page(pool_caches: KVCacheList, src, dst) -> KVCacheList:
+    return [
+        {"k": c["k"].at[dst].set(c["k"][src]), "v": c["v"].at[dst].set(c["v"][src])}
+        for c in pool_caches
+    ]

@@ -101,9 +101,23 @@ class Scheduler:
     whose deadline already passed, and `queue_depth` feeds telemetry.
     """
 
-    def __init__(self, max_waiting: int = 128, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        max_waiting: int = 128,
+        clock: Callable[[], float] = time.monotonic,
+        prefill_chunk_tokens: int = 512,
+    ):
         assert max_waiting > 0
+        if prefill_chunk_tokens <= 0 or prefill_chunk_tokens % 8 != 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be a positive multiple of 8, got "
+                f"{prefill_chunk_tokens}"
+            )
         self.max_waiting = max_waiting
+        # per-engine-step prefill token budget (chunked prefill): long prompts are
+        # computed `prefill_chunk_tokens` at a time, interleaved with decode steps, so a
+        # long arrival cannot stall the inter-token latency of running requests
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         self.clock = clock
         self.waiting: deque[RequestState] = deque()
         self._ids = itertools.count()
@@ -125,6 +139,16 @@ class Scheduler:
     def expired(self, state: RequestState) -> bool:
         deadline = state.request.deadline_s
         return deadline is not None and (self.clock() - state.submit_t) > deadline
+
+    def pop_next(self) -> RequestState | None:
+        """Pop the FCFS head (deadline checks are the caller's job — the paged engine
+        needs to weigh page availability before committing, see `push_front`)."""
+        return self.waiting.popleft() if self.waiting else None
+
+    def push_front(self, state: RequestState) -> None:
+        """Return a popped request to the head of the queue unchanged — the paged
+        engine's "not enough pages yet" path, preserving FCFS order."""
+        self.waiting.appendleft(state)
 
     def admissible(self, free_slots: int) -> tuple[list[RequestState], list[RequestState]]:
         """Pop up to `free_slots` requests FCFS. Returns (admit, expired): requests whose

@@ -94,12 +94,16 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
     # training health subsystem (utils/diagnostics.py)
     "health": ("step", "stats"),
     "model_report": ("param_groups", "totals", "hbm"),
-    # continuous-batching serving engine (serving/engine.py): queue/slot state is
+    # continuous-batching serving engine (serving/engine.py): queue/slot/page state is
     # instantaneous, rates and counters are cumulative over the engine's lifetime
+    # (pages_* / page_fragmentation are null when the dense slot pool is in use)
     "serving": (
         "queue_depth",
         "slots_active",
         "num_slots",
+        "pages_in_use",
+        "pages_total",
+        "page_fragmentation",
         "ttft_ms",
         "prefill_tok_s",
         "decode_tok_s",
@@ -129,6 +133,11 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     "serving_requests_cancelled",
     "serving_prefill_tokens",
     "serving_decode_tokens",
+    # paged-pool prefix caching (serving/prefix_cache.py): prompt tokens whose K/V were
+    # already resident (skipped prefill) vs tokens actually computed — hit rate is
+    # hit / (hit + miss), rendered by tools/telemetry_summary.py
+    "serving_prefix_hit_tokens",
+    "serving_prefix_miss_tokens",
 )
 
 KNOWN_EVENTS: tuple[str, ...] = (
@@ -150,6 +159,10 @@ KNOWN_GAUGES: tuple[str, ...] = (
     # serving engine (serving/engine.py)
     "serving/queue_depth",
     "serving/slot_occupancy",
+    # paged KV pool (serving/kv_cache.py): physical pages referenced by slots/prefix
+    # index, and the fraction of allocated page capacity not holding valid tokens
+    "serving/pages_in_use",
+    "serving/page_fragmentation",
 )
 
 # goodput buckets, in reporting order; "other" is the window remainder (python overhead,

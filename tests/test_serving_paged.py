@@ -1,0 +1,411 @@
+"""Paged KV cache tests: page allocator invariants, prefix-cache sharing/eviction,
+copy-on-write tail isolation, chunked-prefill scheduling fairness, and bit-exact parity
+vs `generate_tokens` with the paged pool, prefix hits, and chunked prefill all active.
+
+All model paths are unsharded (no mesh, no `init_params`) — the sharded-model path fails
+at seed from the logical-axis rules skew and would mask the feature under test.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.generation_utils import generate_tokens
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import (
+    TRASH_PAGE,
+    PagedKVCachePool,
+    PrefixCache,
+    SamplingParams,
+    ServingEngine,
+    serve_batch,
+)
+
+from .test_commons import get_dense_test_config
+
+PAGE = 16
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+def _expected(model, params, config, prompt, rng, max_new, sampling=None):
+    sampling = sampling or SamplingParams()
+    ids = jnp.asarray([prompt], jnp.int32)
+    out, _ = generate_tokens(
+        model,
+        params,
+        ids,
+        jnp.ones_like(ids),
+        rng,
+        max_new_tokens=max_new,
+        do_sample=sampling.do_sample,
+        temperature=sampling.temperature,
+        top_k=sampling.top_k,
+        top_p=sampling.top_p,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+    )
+    return [int(t) for t in np.asarray(out[0])]
+
+
+# ---------------------------------------------------------------------------- page pool
+
+
+def test_page_pool_alloc_free_refcount_invariants():
+    _, model, _ = _tiny_model()
+    pool = PagedKVCachePool(model, num_slots=2, max_len=64, page_size=PAGE, num_pages=6)
+
+    # page arrays have the [num_pages, page_size, H, D] layout; page 0 is never handed out
+    assert pool.caches[0]["k"].shape[:2] == (6, PAGE)
+    assert pool.max_pages_per_slot == 4
+
+    slot = pool.allocate()
+    pool.reserve(slot, 3)
+    assert pool.available_pages == 5 - 3  # 5 allocatable (trash excluded), 3 promised
+    first = pool.alloc_page(slot, 0)
+    second = pool.alloc_page(slot, 1)
+    assert TRASH_PAGE not in (first, second)
+    assert pool.refcounts[first] == 1 and pool.page_table[slot, 0] == first
+    assert pool.pages_in_use == 2
+    # allocations consumed the reservation, not the open budget
+    assert pool.available_pages == 2
+
+    other = pool.allocate()
+    with pytest.raises(ValueError):
+        pool.reserve(other, 3)  # only 2 unreserved pages left
+    pool.reserve(other, 2)
+    pool.attach_shared(other, 0, first)  # prefix hit: read-only share, refcount bump
+    assert pool.refcounts[first] == 2
+
+    pool.free(slot)  # drops its references; `first` survives through `other`
+    assert pool.refcounts[first] == 1 and pool.refcounts[second] == 0
+    assert pool.page_table[slot, 0] == TRASH_PAGE
+    with pytest.raises(ValueError):
+        pool.free(slot)  # double slot free
+    pool.free(other)
+    assert pool.refcounts[first] == 0 and pool.pages_in_use == 0
+    assert pool.available_pages == 5  # reservations fully returned
+    with pytest.raises(ValueError):
+        pool.decref(first)  # double page free
+
+
+def test_page_pool_validation():
+    _, model, _ = _tiny_model()
+    with pytest.raises(ValueError):
+        PagedKVCachePool(model, num_slots=1, max_len=32, page_size=12)  # not a multiple of 8
+    with pytest.raises(ValueError):
+        PagedKVCachePool(model, num_slots=1, max_len=32, page_size=16, num_pages=1)
+    with pytest.raises(ValueError):
+        ServingEngine(model, {}, num_slots=1, max_len=32, page_size=10)
+    with pytest.raises(ValueError):
+        ServingEngine(model, {}, num_slots=1, max_len=32, prefill_chunk_tokens=12)
+
+
+def test_fragmentation_gauge():
+    _, model, _ = _tiny_model()
+    pool = PagedKVCachePool(model, num_slots=2, max_len=64, page_size=PAGE, num_pages=9)
+    assert pool.page_fragmentation == 0.0
+    slot = pool.allocate()
+    pool.reserve(slot, 2)
+    pool.alloc_page(slot, 0)
+    pool.alloc_page(slot, 1)
+    pool.lengths[slot] = PAGE + 4  # second page 4/16 full
+    assert pool.page_fragmentation == pytest.approx(12 / (2 * PAGE))
+
+
+# ---------------------------------------------------------------------------- prefix cache
+
+
+def test_prefix_cache_chain_identity_and_partial_match():
+    _, model, _ = _tiny_model()
+    pool = PagedKVCachePool(model, num_slots=2, max_len=64, page_size=8, num_pages=12)
+    cache = PrefixCache(page_size=8)
+
+    slot = pool.allocate()
+    pool.reserve(slot, 3)
+    pages = [pool.alloc_page(slot, i) for i in range(3)]
+    tokens = list(range(1, 25))  # 3 full pages of 8
+    assert cache.register(tokens, pages, pool) == 3
+    assert all(pool.refcounts[p] == 2 for p in pages)  # slot + index
+
+    # full-page hits stop at the first divergence; chain identity means a same-content
+    # page under a DIFFERENT prefix never aliases
+    match = cache.match(tokens[:16] + [99, 98, 97, 96, 95])
+    assert [n.page for n in match.nodes] == pages[:2]
+    assert match.cow is None and match.resume_pos == 16
+    divergent = cache.match(tokens[8:16] + tokens[:8] + [17])
+    assert divergent.nodes == [] and divergent.resume_pos == 0
+
+    # partial tail: 2 full pages + 4 of the third page's 8 tokens -> COW candidate
+    match = cache.match(tokens[:20] + [42])
+    assert [n.page for n in match.nodes] == pages[:2]
+    assert match.cow is not None and match.cow.page == pages[2] and match.cow_len == 4
+    assert match.resume_pos == 20  # copied tokens skip recompute; 42 is computed
+
+    # page-aligned full match: last page demoted to COW so the final token is recomputed
+    match = cache.match(tokens)
+    assert [n.page for n in match.nodes] == pages[:2]
+    assert match.cow.page == pages[2] and match.resume_pos == len(tokens) - 1
+
+
+def test_prefix_cache_lru_leaf_eviction():
+    _, model, _ = _tiny_model()
+    pool = PagedKVCachePool(model, num_slots=2, max_len=64, page_size=8, num_pages=12)
+    cache = PrefixCache(page_size=8)
+
+    slot = pool.allocate()
+    pool.reserve(slot, 2)
+    chain_a = [pool.alloc_page(slot, 0), pool.alloc_page(slot, 1)]
+    cache.register(list(range(16)), chain_a, pool)
+    pool.free(slot)  # index alone keeps the chain resident
+
+    slot = pool.allocate()
+    pool.reserve(slot, 1)
+    chain_b = [pool.alloc_page(slot, 0)]
+    cache.register([9] * 8, chain_b, pool)
+    pool.free(slot)
+    assert len(cache) == 3 and pool.pages_in_use == 3
+
+    cache.match(list(range(16)) + [77])  # touch chain A: B becomes LRU
+    assert cache.evict(1, pool) == 1
+    assert pool.refcounts[chain_b[0]] == 0  # LRU leaf went first
+    # chain A evicts leaf-first (depth-1 page before its parent)
+    assert cache.evict(2, pool) == 2
+    assert pool.pages_in_use == 0 and len(cache) == 0
+
+    # nothing left to evict
+    assert cache.evict(1, pool) == 0
+
+
+def test_cow_tail_page_isolation():
+    """Two requests sharing a prefix that ends mid-page must not write into each other's
+    tail page: the second request gets a COPY (fresh physical page) and the donor page's
+    content is bit-identical before and after the second request decodes over its copy."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(21)
+    engine = ServingEngine(
+        model, params, num_slots=2, max_len=64, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id, page_size=PAGE,
+    )
+    shared = _random_prompt(rs, config, PAGE + 6)  # prefix boundary mid-page
+    prompt_a = shared + _random_prompt(rs, config, 3)
+    prompt_b = shared + _random_prompt(rs, config, 5)
+
+    # A decodes enough that its second page FILLS (written = 25 + 12 - 1 = 36 >= 32), so
+    # the page holding the shared tail gets registered and becomes B's COW donor
+    state_a = serve_batch(
+        engine, [dict(prompt_ids=prompt_a, max_new_tokens=12, rng=jax.random.PRNGKey(1))]
+    )[0]
+    # request A's pages are resident in the prefix index now; find its tail page
+    match = engine.prefix.match(prompt_b)
+    assert len(match.nodes) == 1 and match.cow is not None  # 1 full page + partial tail
+    donor_page = match.cow.page
+    donor_k_before = np.asarray(engine.pool.caches[0]["k"][donor_page])
+
+    state_b = serve_batch(
+        engine, [dict(prompt_ids=prompt_b, max_new_tokens=3, rng=jax.random.PRNGKey(2))]
+    )[0]
+    donor_k_after = np.asarray(engine.pool.caches[0]["k"][donor_page])
+    np.testing.assert_array_equal(donor_k_before, donor_k_after)  # donor untouched
+
+    # both decoded exactly what a solo generate_tokens produces (B recomputed its suffix
+    # over the private copy; A's resident K/V fed B's shared pages)
+    assert state_a.tokens == _expected(model, params, config, prompt_a, jax.random.PRNGKey(1), 12)
+    assert state_b.tokens == _expected(model, params, config, prompt_b, jax.random.PRNGKey(2), 3)
+    assert engine.stats.prefix_hit_tokens > 0
+
+
+# ---------------------------------------------------------------------------- engine e2e
+
+
+def test_paged_engine_parity_with_prefix_and_chunked_prefill():
+    """Acceptance: mixed greedy/sampled requests with shared prefixes, a chunk budget
+    small enough to split every long prompt, and async arrivals decode token-for-token
+    like one-shot generate_tokens calls; the decode step compiles exactly once; all slot
+    rows are reclaimed; only prefix-index pages stay resident."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(3)
+    shared = _random_prompt(rs, config, 2 * PAGE)
+    prompts = [
+        shared + _random_prompt(rs, config, 5),
+        shared + _random_prompt(rs, config, 9),
+        _random_prompt(rs, config, 41),
+        shared + _random_prompt(rs, config, 2),
+        _random_prompt(rs, config, 7),
+    ]
+    samplings = [
+        SamplingParams(),
+        SamplingParams(do_sample=True, temperature=0.8),
+        SamplingParams(do_sample=True, temperature=1.2, top_k=7),
+        SamplingParams(do_sample=True, top_p=0.9),
+        SamplingParams(do_sample=True, temperature=0.7, top_k=20, top_p=0.95),
+    ]
+    rngs = [jax.random.PRNGKey(100 + i) for i in range(5)]
+    max_new = 6
+
+    engine = ServingEngine(
+        model, params, num_slots=2, max_len=96, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id,
+        page_size=PAGE, prefill_chunk_tokens=16,  # every prompt needs >= 2 chunks cold
+    )
+    states = [
+        engine.submit(prompt_ids=prompts[i], max_new_tokens=max_new, sampling=samplings[i], rng=rngs[i])
+        for i in range(3)
+    ]
+    for _ in range(4):
+        engine.step()
+    states += [
+        engine.submit(prompt_ids=prompts[i], max_new_tokens=max_new, sampling=samplings[i], rng=rngs[i])
+        for i in (3, 4)
+    ]
+    engine.drain()
+
+    for i, state in enumerate(states):
+        assert state.tokens == _expected(
+            model, params, config, prompts[i], rngs[i], max_new, samplings[i]
+        ), f"request {i} diverged"
+
+    assert engine.decode_compiles == 1  # the static-shape invariant, chunks included
+    assert engine.pool.num_free == engine.pool.num_slots
+    assert engine.stats.prefix_hit_tokens > 0  # requests 1 and 3 reused the shared pages
+    # every remaining page reference is the prefix index's
+    resident = sum(int(r) for r in engine.pool.refcounts)
+    assert resident == len(engine.prefix)
+
+    # prefix caching off: pool returns to empty after drain
+    engine2 = ServingEngine(
+        model, params, num_slots=2, max_len=96, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id,
+        page_size=PAGE, prefix_caching=False,
+    )
+    state = serve_batch(
+        engine2, [dict(prompt_ids=prompts[0], max_new_tokens=max_new, rng=rngs[0])]
+    )[0]
+    assert state.tokens == _expected(model, params, config, prompts[0], rngs[0], max_new)
+    assert engine2.pool.pages_in_use == 0 and engine2.prefix is None
+
+
+def test_chunked_prefill_fairness():
+    """A long arriving prompt must not stall a running request: with the prefill budget
+    at one chunk per step, the running request keeps emitting one token per engine step
+    while the long prompt prefills across multiple steps."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(9)
+    engine = ServingEngine(
+        model, params, num_slots=2, max_len=96, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id,
+        page_size=PAGE, prefill_chunk_tokens=8,
+    )
+    short = engine.submit(
+        prompt_ids=_random_prompt(rs, config, 5), max_new_tokens=12, rng=jax.random.PRNGKey(1)
+    )
+    engine.step()  # short is running
+    assert short.num_generated >= 1
+
+    long_prompt = _random_prompt(rs, config, 40)  # 5 chunks at budget 8
+    long_state = engine.submit(
+        prompt_ids=long_prompt, max_new_tokens=2, rng=jax.random.PRNGKey(2)
+    )
+    progress = []
+    for _ in range(5):
+        before = short.num_generated
+        engine.step()
+        progress.append(short.num_generated - before)
+        # budget bounds per-step prefill work while the long prompt is in flight
+        if long_state.num_generated == 0:
+            assert engine._prefill_tasks or long_state.num_generated > 0
+    # the running request advanced EVERY step the long prefill was in flight
+    assert all(p == 1 for p in progress), progress
+    engine.drain()
+    assert long_state.tokens == _expected(
+        model, params, config, long_prompt, jax.random.PRNGKey(2), 2
+    )
+    assert short.tokens == _expected(
+        model, params, config, short.request.prompt_ids, jax.random.PRNGKey(1), 12
+    )
+
+
+def test_page_exhaustion_queues_fcfs_no_deadlock():
+    """More concurrent demand than pages: admission blocks at the queue head until pages
+    free up, everything completes FCFS, and submit rejects a request that could never fit."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(11)
+    # 4 slot rows but only 5 allocatable pages; each request worst-cases 2 pages
+    engine = ServingEngine(
+        model, params, num_slots=4, max_len=96, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id,
+        page_size=PAGE, num_pages=6, prefix_caching=False,
+    )
+    with pytest.raises(ValueError):
+        # fits max_len (92 <= 96) but worst-cases 6 pages > the 5 allocatable
+        engine.submit(prompt_ids=_random_prompt(rs, config, 80), max_new_tokens=12)
+    finish_order = []
+    states = [
+        engine.submit(
+            prompt_ids=_random_prompt(rs, config, 20),
+            max_new_tokens=4,
+            on_finish=lambda st, i=i: finish_order.append(i),
+        )
+        for i in range(5)
+    ]
+    while engine.has_work():
+        engine.step()
+        assert engine.pool.num_active <= 2  # 5 pages / 2-page requests
+        assert engine.pool.available_pages >= 0
+    assert finish_order == [0, 1, 2, 3, 4]
+    assert engine.stats.completed == 5
+    assert engine.pool.pages_in_use == 0
+
+
+def test_serving_record_page_fields(tmp_path):
+    from dolomite_engine_tpu.utils.telemetry import (
+        RECORD_SCHEMA,
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(13)
+    sink = tmp_path / "serving.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=32, prefill_bucket_multiple=8,
+            eos_token_id=None, pad_token_id=config.pad_token_id, page_size=PAGE,
+        )
+        shared = _random_prompt(rs, config, PAGE)
+        serve_batch(
+            engine,
+            [dict(prompt_ids=shared + _random_prompt(rs, config, 3), max_new_tokens=3) for _ in range(3)],
+        )
+        telemetry.close()
+    finally:
+        uninstall_telemetry()
+
+    records = [json.loads(line) for line in open(sink)]
+    final = [r for r in records if r["kind"] == "serving"][-1]
+    for field in RECORD_SCHEMA["serving"]:
+        assert field in final, field
+    assert final["pages_total"] == engine.pool.num_pages - 1
+    assert final["pages_in_use"] == engine.pool.pages_in_use > 0  # prefix-resident pages
+    assert final["page_fragmentation"] is not None
+    counters = final["counters"]
+    assert counters["prefix_hit_tokens"] > 0  # requests 2 and 3 hit the shared page
+    assert counters["prefix_hit_tokens"] + counters["prefix_miss_tokens"] == sum(
+        PAGE + 3 for _ in range(3)
+    )
+    assert telemetry.counters["serving_prefix_hit_tokens"] == counters["prefix_hit_tokens"]

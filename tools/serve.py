@@ -38,6 +38,30 @@ def parse_args() -> argparse.Namespace:
         help="per-slot cache length (default: longest prompt bucket + max_new_tokens)",
     )
     p.add_argument("--bucket-multiple", type=int, default=64, help="prefill width bucket")
+    p.add_argument(
+        "--dense-kv",
+        action="store_true",
+        help="use the dense [num_slots, max_len] slot pool instead of the paged pool",
+    )
+    p.add_argument("--page-size", type=int, default=16, help="tokens per KV page (multiple of 8)")
+    p.add_argument(
+        "--num-pages",
+        type=int,
+        default=None,
+        help="physical KV pages (default: dense-parity capacity; set to the HBM budget "
+        "to oversubscribe slots)",
+    )
+    p.add_argument(
+        "--prefill-chunk-tokens",
+        type=int,
+        default=512,
+        help="per-step prefill token budget (chunked prefill; multiple of 8)",
+    )
+    p.add_argument(
+        "--no-prefix-cache",
+        action="store_true",
+        help="disable prefix caching (page-aligned prompt prefix reuse)",
+    )
     p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
     p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
     p.add_argument("--seed", type=int, default=0)
@@ -98,6 +122,11 @@ def main() -> None:
         pad_token_id=pad_token_id,
         rng=jax.random.PRNGKey(args.seed),
         record_interval=100,
+        paged=not args.dense_kv,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        prefix_caching=not args.no_prefix_cache,
     )
 
     sampling = SamplingParams(
@@ -147,6 +176,16 @@ def main() -> None:
     ttft = stats.mean_ttft_s()
     prefill_rate = stats.prefill_tok_s()
     decode_rate = stats.decode_tok_s()
+    hit_rate = stats.prefix_hit_rate()
+    paged_info = ""
+    if engine.paged:
+        paged_info = (
+            f", pages={engine.pool.pages_in_use}/{engine.pool.num_pages - 1} "
+            f"(frag {engine.pool.page_fragmentation:.1%}), "
+            f"prefix hit rate={'n/a' if hit_rate is None else f'{hit_rate:.1%}'} "
+            f"({stats.prefix_hit_tokens} of "
+            f"{stats.prefix_hit_tokens + stats.prefix_miss_tokens} prompt tokens reused)"
+        )
     print(
         f"served {len(states)} request(s): "
         f"completed={stats.completed} cancelled={stats.cancelled}, "
@@ -154,7 +193,8 @@ def main() -> None:
         f"prefill={'n/a' if prefill_rate is None else f'{prefill_rate:.0f}'} tok/s, "
         f"decode={'n/a' if decode_rate is None else f'{decode_rate:.0f}'} tok/s, "
         f"decode compiles={engine.decode_compiles}, "
-        f"free slots={engine.pool.num_free}/{engine.pool.num_slots}",
+        f"free slots={engine.pool.num_free}/{engine.pool.num_slots}"
+        f"{paged_info}",
         file=sys.stderr,
     )
 

@@ -149,6 +149,7 @@ def summarize(records: list[dict]) -> str:
     run_ends = [r for r in records if r.get("kind") == "run_end"]
     healths = [r for r in records if r.get("kind") == "health"]
     model_reports = [r for r in records if r.get("kind") == "model_report"]
+    servings = [r for r in records if r.get("kind") == "serving"]
 
     lines: list[str] = []
 
@@ -226,6 +227,35 @@ def summarize(records: list[dict]) -> str:
         lines.append("**" + ", ".join(summary) + "**")
         lines.append("")
 
+    # ---------------------------------------------------------------- serving
+    if servings:
+        last = servings[-1]  # counters/rates are cumulative, so the last record is total
+        counters = last.get("counters") or {}
+        parts = [
+            f"serving: {counters.get('completed', 0)} completed / "
+            f"{counters.get('admitted', 0)} admitted"
+        ]
+        if last.get("ttft_ms") is not None:
+            parts.append(f"ttft {last['ttft_ms']:.0f}ms")
+        if last.get("prefill_tok_s") is not None:
+            parts.append(f"prefill {last['prefill_tok_s']:.0f} tok/s")
+        if last.get("decode_tok_s") is not None:
+            parts.append(f"decode {last['decode_tok_s']:.0f} tok/s")
+        hit = counters.get("prefix_hit_tokens", 0)
+        miss = counters.get("prefix_miss_tokens", 0)
+        if hit + miss > 0:
+            parts.append(
+                f"prefix hit rate {100.0 * hit / (hit + miss):.1f}% "
+                f"({hit}/{hit + miss} prompt tokens reused)"
+            )
+        if last.get("pages_in_use") is not None:
+            page_line = f"pages {last['pages_in_use']}/{last.get('pages_total', '?')}"
+            if last.get("page_fragmentation") is not None:
+                page_line += f" (frag {100.0 * last['page_fragmentation']:.1f}%)"
+            parts.append(page_line)
+        lines.append(", ".join(parts))
+        lines.append("")
+
     # ---------------------------------------------------------------- health / anomalies
     if healths:
         last = healths[-1]  # the latest per-group snapshot is what a triage wants first
@@ -285,7 +315,7 @@ def summarize(records: list[dict]) -> str:
         )
         lines.append("")
 
-    if not (steps or windows or events or run_starts or healths or model_reports):
+    if not (steps or windows or events or run_starts or healths or model_reports or servings):
         lines.append("(no telemetry records found)")
     return "\n".join(lines).rstrip() + "\n"
 
