@@ -688,6 +688,18 @@ class GenerationParameters(BaseArgs):
     prefill_chunk_tokens: int = 512
     # share page-aligned resident prompt prefixes across requests (RadixAttention-style)
     prefix_caching: bool = True
+    # ---- speculative decoding (serving/engine.py, docs/SERVING.md) ----
+    # n-gram / prompt-lookup self-drafting: propose draft tokens by matching the slot's
+    # recent suffix against its own prompt+generation history (no extra model; strongest
+    # on repetitive workloads — code edits, summarization, RAG over the prompt)
+    speculate_ngram: bool = False
+    # draft-model checkpoint (dolomite-format path or hub id): a smaller supported model
+    # drafts greedily for the target. Mutually exclusive with speculate_ngram; must share
+    # the target's tokenizer/vocab
+    draft_model: str | None = None
+    # draft tokens proposed per engine step (K >= 1); the jitted verify step scores K+1
+    # positions per slot and compiles once
+    draft_k: int = 4
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(
@@ -711,6 +723,12 @@ class GenerationParameters(BaseArgs):
             raise ValueError(
                 f"kv_num_pages must be >= 2 (page 0 is the trash page), got "
                 f"{self.kv_num_pages}"
+            )
+        if self.draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
+        if self.speculate_ngram and self.draft_model is not None:
+            raise ValueError(
+                "speculate_ngram and draft_model are mutually exclusive draft sources"
             )
 
 

@@ -48,6 +48,9 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
         "kv_num_pages",
         "prefill_chunk_tokens",
         "prefix_caching",
+        "speculate_ngram",
+        "draft_model",
+        "draft_k",
     ):
         generate_kwargs.pop(key, None)
 
@@ -132,6 +135,7 @@ def _generate_with_engine(
     pad_token_id = next(
         (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
     )
+    draft_model, draft_params = load_draft_model(gp.draft_model)
     engine = ServingEngine(
         model.model,
         params,
@@ -146,6 +150,10 @@ def _generate_with_engine(
         num_pages=gp.kv_num_pages,
         prefill_chunk_tokens=gp.prefill_chunk_tokens,
         prefix_caching=gp.prefix_caching,
+        speculate_ngram=gp.speculate_ngram,
+        draft_model=draft_model,
+        draft_params=draft_params,
+        draft_k=gp.draft_k,
     )
 
     for dataset in datasets_list:
@@ -178,6 +186,19 @@ def _generate_with_engine(
                     + "\n"
                 )
         log_rank_0(20, f"wrote {output_path}")
+
+
+def load_draft_model(name: str | None) -> tuple:
+    """Load a draft checkpoint for speculative decoding via the same HF import path as
+    the target (any supported family can draft for a larger one — the models only need
+    to share a tokenizer/vocab). Returns (flax module, params) or (None, None)."""
+    if not name:
+        return None, None
+    from .model_wrapper import ModelWrapperForFinetuning
+
+    wrapper = ModelWrapperForFinetuning(mode=Mode.inference, model_name=name)
+    draft_params = wrapper.load_pretrained_params(name, MeshManager.get_mesh())
+    return wrapper.model, draft_params
 
 
 def _pad_to_static_shapes(

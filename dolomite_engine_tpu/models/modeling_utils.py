@@ -227,8 +227,12 @@ def update_kv_cache(
     not-yet-written slots. Returns (key, value, kv_cache, attention_mask, query_offset).
 
     `cache_index` is normally a scalar shared by the whole batch. A per-row [B] vector is
-    the continuous-batching decode case (serving/engine.py): every slot writes its single
-    new token at its own length, so the validity frontier is per-row too.
+    the continuous-batching case (serving/engine.py): every slot writes its `seq` new
+    tokens starting at its own length, so the validity frontier is per-row too. `seq` is 1
+    for plain decode and K+1 for the speculative verify step (the last committed token
+    plus K draft tokens scored in one call); per-row writes past the cache length are
+    DROPPED, so a verify window overhanging `max_len` near the end of a request cannot
+    wrap or clobber other rows — the overhanging drafts are rejected host-side anyway.
 
     A cache dict carrying a ``page_table`` is a PAGED pool view
     (serving/kv_cache.PagedKVCachePool): ``k``/``v`` are the shared ``[num_pages,
@@ -239,11 +243,10 @@ def update_kv_cache(
     if "page_table" in kv_cache:
         return _update_paged_kv_cache(key, value, kv_cache, cache_index, attention_mask)
     if getattr(cache_index, "ndim", 0) == 1:
-        if seq != 1:
-            raise NotImplementedError("per-row cache_index supports single-token decode only")
-        rows = jnp.arange(key.shape[0])
-        k_cache = kv_cache["k"].at[rows, cache_index].set(key[:, 0])
-        v_cache = kv_cache["v"].at[rows, cache_index].set(value[:, 0])
+        rows = jnp.arange(key.shape[0])[:, None]
+        positions = cache_index[:, None] + jnp.arange(seq)  # [B, S]
+        k_cache = kv_cache["k"].at[rows, positions].set(key, mode="drop")
+        v_cache = kv_cache["v"].at[rows, positions].set(value, mode="drop")
         valid = jnp.arange(k_cache.shape[1])[None, :] < (cache_index[:, None] + seq)
     else:
         k_cache = jax.lax.dynamic_update_slice(kv_cache["k"], key, (0, cache_index, 0, 0))
@@ -280,9 +283,11 @@ def _update_paged_kv_cache(
     view_len = table.shape[1] * page_size
 
     if getattr(cache_index, "ndim", 0) == 1:
-        if seq != 1:
-            raise NotImplementedError("per-row cache_index supports single-token decode only")
-        positions = cache_index[:, None].astype(jnp.int32)  # [B, 1]
+        # [B, S]: decode (S=1) and the speculative verify window (S=K+1) both write each
+        # row's tokens at its own frontier; unmapped pages + overhang land in trash
+        positions = (
+            cache_index[:, None] + jnp.arange(seq, dtype=jnp.int32)[None, :]
+        ).astype(jnp.int32)
         frontier = cache_index[:, None] + seq  # [B, 1]
     else:
         positions = jnp.broadcast_to(

@@ -38,8 +38,12 @@ def make_attention_mask(
 ) -> jax.Array | None:
     """Boolean [B, 1, Sq, Skv] mask (True = attend), or None when fully visible.
 
-    `query_offset` may be a per-row [B] vector (continuous-batching decode: every slot
-    continues at its own cache position), producing a per-row causal frontier."""
+    `query_offset` may be a per-row [B] vector (continuous batching: every slot
+    continues at its own cache position), producing a per-row causal frontier. This
+    composes with Sq > 1: the speculative verify step scores K+1 positions per slot in
+    one call, and query i of row b may attend keys up to ``query_offset[b] + i`` — draft
+    token i sees the in-flight K/V of drafts 0..i-1 written in the same call, exactly
+    what sequential decode would have resident."""
     mask = None
 
     if causal:

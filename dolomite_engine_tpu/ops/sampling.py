@@ -70,29 +70,16 @@ NO_TOP_K = 0
 NO_TOP_P = 1.0
 
 
-def sample_tokens_vectorized(
+def process_logits_vectorized(
     logits: jax.Array,
-    rngs: jax.Array,
-    do_sample: jax.Array,
     temperature: jax.Array,
     top_k: jax.Array,
     top_p: jax.Array,
 ) -> jax.Array:
-    """Per-row sampling with traced [S]-shaped params — the continuous-batching decode
-    step, where every slot carries its own request's sampling settings, so one compiled
-    program serves every request mix (serving/engine.py).
-
-    Row `s` reproduces ``sample_token(logits[s:s+1], rngs[s], **row_params)`` bit-for-bit:
-    disabled processors use the inert encodings above (`NO_TEMPERATURE`/`NO_TOP_K`/
-    `NO_TOP_P`) rather than being skipped, and the per-row key drives the same
-    `jax.random.categorical` a single-request call would.
-
-    Args: logits [S, V]; rngs [S]-stacked PRNG keys; do_sample [S] bool;
-    temperature/top_p [S] float; top_k [S] int. Returns [S] int32.
-    """
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
+    """Temperature -> top-k -> top-p over [S, V] fp32 logits with per-row traced params
+    (inert encodings above mean "processor off"). Row `s` reproduces `sample_token`'s
+    processor chain bit-for-bit; the filtered logits feed `jax.random.categorical`
+    directly (sampling) or a softmax (the speculative acceptance probabilities)."""
     vocab = logits.shape[-1]
     x = logits / jnp.maximum(temperature, 1e-6)[:, None]
 
@@ -110,10 +97,127 @@ def sample_tokens_vectorized(
     keep = keep.at[..., 0].set(True)
     keep = keep | (top_p >= 1.0)[:, None]
     threshold = jnp.min(jnp.where(keep, sorted_x, jnp.inf), axis=-1, keepdims=True)
-    x = jnp.where(x < threshold, _NEG_INF, x)
+    return jnp.where(x < threshold, _NEG_INF, x)
 
-    sampled = jax.vmap(jax.random.categorical)(rngs, x).astype(jnp.int32)
-    return jnp.where(do_sample, sampled, greedy)
+
+def sample_tokens_vectorized(
+    logits: jax.Array,
+    rngs: jax.Array,
+    do_sample: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-row sampling with traced [S]-shaped params — the continuous-batching decode
+    step, where every slot carries its own request's sampling settings, so one compiled
+    program serves every request mix (serving/engine.py).
+
+    Row `s` reproduces ``sample_token(logits[s:s+1], rngs[s], **row_params)`` bit-for-bit:
+    disabled processors use the inert encodings above (`NO_TEMPERATURE`/`NO_TOP_K`/
+    `NO_TOP_P`) rather than being skipped, and the per-row key drives the same
+    `jax.random.categorical` a single-request call would.
+
+    Greedy fast path: the processor chain + categorical run under a `lax.cond` on
+    ``any(do_sample)``, so an all-greedy batch (the common serving case) pays one argmax
+    at runtime instead of two vocab sorts and a batch of categorical draws — same single
+    compiled program (cond stages both branches), bit-identical outputs either way.
+
+    Args: logits [S, V]; rngs [S]-stacked PRNG keys; do_sample [S] bool;
+    temperature/top_p [S] float; top_k [S] int. Returns [S] int32.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sampling_branch(operands):
+        logits, rngs, do_sample, temperature, top_k, top_p, greedy = operands
+        x = process_logits_vectorized(logits, temperature, top_k, top_p)
+        sampled = jax.vmap(jax.random.categorical)(rngs, x).astype(jnp.int32)
+        return jnp.where(do_sample, sampled, greedy)
+
+    return jax.lax.cond(
+        jnp.any(do_sample),
+        _sampling_branch,
+        lambda operands: operands[6],
+        (logits, rngs, do_sample, temperature, top_k, top_p, greedy),
+    )
+
+
+def speculative_accept(
+    logits: jax.Array,
+    draft_tokens: jax.Array,
+    num_drafts: jax.Array,
+    rngs: jax.Array,
+    do_sample: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Vectorized speculative-decoding acceptance + bonus-token resample (the in-graph
+    half of the serving engine's verify step).
+
+    ``logits`` are the target model's scores over the K+1 verify positions: position
+    ``i`` conditions on the last committed token plus drafts ``1..i``, so it is the
+    target's distribution for draft ``i+1`` (and position ``j`` supplies the bonus token
+    after ``j`` acceptances). Drafts are DETERMINISTIC proposals (greedy draft model or
+    n-gram lookup — a point-mass q), so the Leviathan et al. 2023 rejection rule
+    specializes to:
+
+    - greedy rows: accept draft ``i+1`` iff it equals ``argmax(logits[i])`` — the
+      accepted prefix plus the bonus token is exactly the sequence step-by-step greedy
+      decode would emit, so greedy outputs stay BIT-EXACT;
+    - sampled rows: accept with probability ``p(draft)`` under the processed
+      (temperature/top-k/top-p) target distribution; on rejection resample from the
+      residual ``norm(max(p - q, 0))``, which for a point-mass q is p with the rejected
+      token's mass removed and renormalized. Emitted tokens are distributed exactly as
+      non-speculative sampling.
+
+    Args: logits [S, K+1, V]; draft_tokens [S, K]; num_drafts [S] int (<= K, 0 disables
+    a row); rngs [S]-stacked PRNG keys; do_sample/temperature/top_k/top_p [S] per-row
+    params. Returns (accepted [S] int32 in [0, num_drafts], bonus_token [S] int32,
+    carry_rngs [S] keys) — each row always emits its accepted drafts plus the bonus.
+    """
+    num_rows, k_plus_1, vocab = logits.shape
+    k = k_plus_1 - 1
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K+1]
+
+    flat = process_logits_vectorized(
+        logits.reshape(num_rows * k_plus_1, vocab),
+        jnp.repeat(temperature, k_plus_1),
+        jnp.repeat(top_k, k_plus_1),
+        jnp.repeat(top_p, k_plus_1),
+    ).reshape(num_rows, k_plus_1, vocab)
+    probs = jax.nn.softmax(flat, axis=-1)
+
+    # same carry/consume split discipline as the decode step: row 0 carries to the next
+    # step, row 1 funds this step's acceptance uniforms + bonus draw
+    split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
+    verify_keys = jax.vmap(jax.random.split)(split[:, 1])  # [S, 2, 2]
+    uniforms = jax.vmap(lambda key: jax.random.uniform(key, (k,)))(verify_keys[:, 0])
+
+    p_draft = jnp.take_along_axis(probs[:, :k], draft_tokens[:, :, None], axis=-1)[..., 0]
+    accept = jnp.where(
+        do_sample[:, None], uniforms < p_draft, draft_tokens == greedy[:, :k]
+    )
+    accept = accept & (jnp.arange(k)[None, :] < num_drafts[:, None])
+    # leading-run length: drafts are positional (draft i+1 conditions on draft i), so the
+    # first rejection invalidates everything after it
+    accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+    bonus_logits = jnp.take_along_axis(flat, accepted[:, None, None], axis=1)[:, 0]
+    bonus_greedy = jnp.take_along_axis(greedy, accepted[:, None], axis=1)[:, 0]
+    # residual distribution on rejection: zero the rejected draft's mass (-inf logit)
+    # and let categorical renormalize; when all drafts were accepted there is no
+    # rejected token and the bonus samples the target distribution unmodified
+    rejected = accepted < num_drafts
+    rejected_token = jnp.take_along_axis(
+        draft_tokens, jnp.minimum(accepted, k - 1)[:, None], axis=1
+    )[:, 0]
+    residual_mask = rejected[:, None] & (jnp.arange(vocab)[None, :] == rejected_token[:, None])
+    bonus_logits = jnp.where(residual_mask, _NEG_INF, bonus_logits)
+    bonus_sampled = jax.vmap(jax.random.categorical)(verify_keys[:, 1], bonus_logits)
+    bonus = jnp.where(do_sample, bonus_sampled.astype(jnp.int32), bonus_greedy)
+    return accepted.astype(jnp.int32), bonus, split[:, 0]
 
 
 def encode_sampling_params(

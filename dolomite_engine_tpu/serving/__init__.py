@@ -2,9 +2,12 @@ from .engine import EngineStats, ServingEngine, serve_batch
 from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
 from .prefix_cache import PrefixCache, PrefixMatch, PrefixNode
 from .scheduler import QueueFullError, Request, RequestState, RequestStatus, SamplingParams, Scheduler
+from .speculation import DraftModelDrafter, NgramDrafter
 
 __all__ = [
+    "DraftModelDrafter",
     "EngineStats",
+    "NgramDrafter",
     "PagedKVCachePool",
     "PrefixCache",
     "PrefixMatch",

@@ -20,7 +20,17 @@ row finishes. This engine is the Orca/vLLM-style fix with fully static shapes:
   (`ops/sampling.sample_tokens_vectorized`), so one compiled program serves any request
   mix and compiles exactly once for the lifetime of the engine;
 - the **scheduler** admits waiting requests into freed slots at every step boundary
-  (FCFS, bounded queue, wall-clock deadlines), page-availability-aware in paged mode.
+  (FCFS, bounded queue, wall-clock deadlines), page-availability-aware in paged mode;
+- **speculative decoding** (optional): a drafter proposes up to K tokens per slot —
+  n-gram/prompt-lookup self-drafting (`speculate_ngram=True`, no extra model) or a
+  smaller greedy draft model (`draft_model=`/`draft_params=`) — and ONE jitted verify
+  step scores all K+1 positions per slot (static K, per-slot traced acceptance in
+  `ops/sampling.speculative_accept`), committing accepted drafts plus a bonus token.
+  Rejected tail writes roll back through the frontier/trash-page discipline: per-slot
+  lengths only advance past K/V the target actually committed, so stale speculative
+  writes are masked and overwritten. Greedy outputs stay bit-exact vs `generate_tokens`;
+  sampled outputs follow the exact target distribution (deterministic-proposal
+  rejection sampling).
 
 Tokens stream out through per-request callbacks the moment the host sees them (one
 device->host sync per step — the price of streaming and EOS detection, identical to the
@@ -42,10 +52,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.sampling import sample_tokens_vectorized
+from ..ops.sampling import sample_tokens_vectorized, speculative_accept
 from ..utils.telemetry import get_telemetry
 from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
 from .prefix_cache import PrefixCache, PrefixMatch
+from .speculation import DraftModelDrafter, NgramDrafter
 from .scheduler import (
     Request,
     RequestState,
@@ -83,6 +94,8 @@ class EngineStats:
     prefix_hit_tokens: int = 0
     prefix_miss_tokens: int = 0
     peak_active: int = 0
+    draft_tokens_proposed: int = 0
+    draft_tokens_accepted: int = 0
 
     def prefill_tok_s(self) -> float | None:
         if self.prefill_seconds <= 0:
@@ -104,6 +117,19 @@ class EngineStats:
         if total == 0:
             return None
         return self.prefix_hit_tokens / total
+
+    def accept_rate(self) -> float | None:
+        """Fraction of proposed draft tokens the target accepted (speculation only)."""
+        if self.draft_tokens_proposed == 0:
+            return None
+        return self.draft_tokens_accepted / self.draft_tokens_proposed
+
+    def accepted_tokens_per_step(self) -> float | None:
+        """Mean accepted draft tokens per decode (verify) step — total emitted tokens
+        per step is this + 1 (the bonus token every verified slot always emits)."""
+        if self.decode_steps == 0:
+            return None
+        return self.draft_tokens_accepted / self.decode_steps
 
 
 @dataclass
@@ -138,8 +164,19 @@ class ServingEngine:
             matches the dense pool's capacity; set it to your HBM budget to oversubscribe
             slots — admission reserves worst-case pages so decode can never run out.
         prefill_chunk_tokens: per-step prefill token budget (positive multiple of 8).
+            With speculation on, the verify step's K+1 computed positions per decoding
+            slot count against the same budget (`Scheduler.prefill_budget`).
         prefix_caching: keep finished requests' page-aligned prefixes resident and share
             them with matching future prompts (paged mode only).
+        speculate_ngram: n-gram / prompt-lookup self-drafting — propose up to `draft_k`
+            tokens per slot by matching the slot's recent suffix against its own
+            prompt+generation history (host-side, no extra model).
+        draft_model / draft_params: a smaller supported model (+ its params) that drafts
+            `draft_k` greedy tokens per slot per step. Mutually exclusive with
+            `speculate_ngram`; must share the target's tokenizer/vocab.
+        draft_k: draft tokens proposed per engine step (K >= 1); the verify step scores
+            K+1 positions per slot and compiles once per engine lifetime.
+        ngram_max: longest suffix length tried by the n-gram drafter (down to 1).
     """
 
     def __init__(
@@ -162,12 +199,25 @@ class ServingEngine:
         num_pages: int | None = None,
         prefill_chunk_tokens: int = 512,
         prefix_caching: bool = True,
+        speculate_ngram: bool = False,
+        draft_model: Any = None,
+        draft_params: Any = None,
+        draft_k: int = 4,
+        ngram_max: int = 3,
     ) -> None:
         if prefill_bucket_multiple <= 0 or prefill_bucket_multiple % 8 != 0:
             raise ValueError(
                 f"prefill_bucket_multiple must be a positive multiple of 8, got "
                 f"{prefill_bucket_multiple}"
             )
+        if speculate_ngram and draft_model is not None:
+            raise ValueError(
+                "speculate_ngram and draft_model are mutually exclusive draft sources"
+            )
+        if draft_model is not None and draft_params is None:
+            raise ValueError("draft_model requires draft_params")
+        if (speculate_ngram or draft_model is not None) and draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {draft_k}")
         config = getattr(model, "config", None)
         n_positions = getattr(config, "n_positions", None)
         if n_positions is not None and max_len > n_positions:
@@ -220,6 +270,30 @@ class ServingEngine:
         decode_impl = self._decode_impl_paged if paged else self._decode_impl
         self._decode_step = jax.jit(decode_impl, donate_argnums=(1,))
 
+        # speculative decoding: drafter (host-side or a small model) + ONE jitted verify
+        # step scoring K+1 positions per slot — replaces the decode step when enabled
+        self.speculating = bool(speculate_ngram or draft_model is not None)
+        self.draft_k = draft_k
+        self._ngram = NgramDrafter(draft_k, ngram_max) if speculate_ngram else None
+        self._draft = (
+            DraftModelDrafter(
+                draft_model,
+                draft_params,
+                num_slots=num,
+                max_len=max_len,
+                draft_k=draft_k,
+                pad_token_id=pad_token_id,
+                prefill_bucket_multiple=prefill_bucket_multiple,
+                cache_dtype=cache_dtype,
+            )
+            if draft_model is not None
+            else None
+        )
+        verify_impl = self._verify_impl_paged if paged else self._verify_impl
+        self._verify_step = (
+            jax.jit(verify_impl, donate_argnums=(1,)) if self.speculating else None
+        )
+
     # ------------------------------------------------------------------ jitted programs
 
     def _decode_impl(self, variables, caches, tokens, lengths, rngs, do_sample, temperature, top_k, top_p):
@@ -257,6 +331,49 @@ class ServingEngine:
         )
         new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
         return new_caches, next_tokens, split[:, 0]
+
+    def _verify_impl(
+        self, variables, caches, tokens, lengths, num_drafts, rngs, do_sample, temperature, top_k, top_p
+    ):
+        """Speculative verify over the dense slot pool: score the [S, K+1] window (last
+        committed token + K drafts) at each row's own cache frontier in ONE call, then
+        accept/resample in-graph. The K+1 writes land at per-row positions; rejected
+        tails stay behind the advanced frontier (masked) until overwritten."""
+        width = tokens.shape[1]
+        positions = lengths[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+        out = self.model.apply(
+            variables,
+            tokens,
+            position_ids=positions,
+            kv_caches=caches,
+            cache_index=lengths,
+        )
+        accepted, bonus, carry = speculative_accept(
+            out.logits, tokens[:, 1:], num_drafts, rngs, do_sample, temperature, top_k, top_p
+        )
+        return out.kv_caches, accepted, bonus, carry
+
+    def _verify_impl_paged(
+        self, variables, caches, page_table, tokens, lengths, num_drafts, rngs, do_sample, temperature, top_k, top_p
+    ):
+        """Paged verify: identical acceptance, but the K+1 writes scatter through each
+        row's page table — unmapped window positions (idle rows, overhang past the
+        request's worst-case pages) land in the trash page."""
+        kv = [{"k": c["k"], "v": c["v"], "page_table": page_table} for c in caches]
+        width = tokens.shape[1]
+        positions = lengths[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+        out = self.model.apply(
+            variables,
+            tokens,
+            position_ids=positions,
+            kv_caches=kv,
+            cache_index=lengths,
+        )
+        accepted, bonus, carry = speculative_accept(
+            out.logits, tokens[:, 1:], num_drafts, rngs, do_sample, temperature, top_k, top_p
+        )
+        new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
+        return new_caches, accepted, bonus, carry
 
     def _get_prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -392,13 +509,24 @@ class ServingEngine:
         self._cancel_expired_running()
         if self.paged:
             self._admit_paged()
-            self._run_prefill_chunks()
+            # decode's computed tokens count against the shared per-step budget: a plain
+            # decode costs 1 token per decoding slot, a verify step K+1 (it really does
+            # score the whole window) — prefill chunks get what is left
+            decoding = sum(1 for s in self._slot_states if s not in self._prefill_tasks)
+            per_slot = self.draft_k + 1 if self.speculating else 1
+            self._run_prefill_chunks(self.scheduler.prefill_budget(per_slot * decoding))
             if any(slot not in self._prefill_tasks for slot in self._slot_states):
-                self._decode_once_paged()
+                if self.speculating:
+                    self._verify_once_paged()
+                else:
+                    self._decode_once_paged()
         else:
             self._admit()
             if self._slot_states:
-                self._decode_once()
+                if self.speculating:
+                    self._verify_once_dense()
+                else:
+                    self._decode_once()
         self.stats.peak_active = max(self.stats.peak_active, self.pool.num_active)
         if (
             self.record_interval
@@ -417,6 +545,17 @@ class ServingEngine:
     def decode_compiles(self) -> int:
         """Number of compiled decode-step variants (the static-shape invariant: 1)."""
         return int(self._decode_step._cache_size())
+
+    @property
+    def verify_compiles(self) -> int:
+        """Compiled verify-step variants — like the decode step, one per (K, width),
+        i.e. exactly 1 for an engine's lifetime regardless of request churn."""
+        return 0 if self._verify_step is None else int(self._verify_step._cache_size())
+
+    @property
+    def draft_compiles(self) -> int:
+        """Compiled draft-model step variants (0 without a draft model, else 1)."""
+        return 0 if self._draft is None else self._draft.draft_compiles
 
     # ------------------------------------------------------------------ dense internals
 
@@ -474,6 +613,8 @@ class ServingEngine:
         self._top_k[slot] = top_k
         self._top_p[slot] = top_p
 
+        if self.speculating:
+            self._spec_start(slot, request.prompt_ids)
         self._deliver(state, first_token)
 
     def _decode_once(self) -> None:
@@ -572,12 +713,14 @@ class ServingEngine:
                 get_telemetry().count("serving_prefix_hit_tokens", hit)
             get_telemetry().count("serving_prefix_miss_tokens", prompt_len - hit)
 
-    def _run_prefill_chunks(self) -> None:
-        """Advance in-flight prefills FCFS, spending at most the scheduler's
-        `prefill_chunk_tokens` budget of REAL prompt tokens this step — decode for
-        already-running slots resumes right after, so their ITL stays bounded no matter
-        how long the arriving prompt is."""
-        budget = self.scheduler.prefill_chunk_tokens
+    def _run_prefill_chunks(self, budget: int | None = None) -> None:
+        """Advance in-flight prefills FCFS, spending at most `budget` REAL prompt tokens
+        this step (default: the scheduler's `prefill_chunk_tokens`; the engine step
+        passes `Scheduler.prefill_budget`, which nets out decode's verified tokens) —
+        decode for already-running slots resumes right after, so their ITL stays bounded
+        no matter how long the arriving prompt is."""
+        if budget is None:
+            budget = self.scheduler.prefill_chunk_tokens
         page_size = self.pool.page_size
         view_len = self.pool.max_pages_per_slot * page_size
         while budget > 0 and self._prefill_order:
@@ -638,6 +781,8 @@ class ServingEngine:
                 self._rngs[slot] = np.array(carry)
                 self._prefill_order.pop(0)
                 del self._prefill_tasks[slot]
+                if self.speculating:
+                    self._spec_start(slot, prompt)
                 self._deliver(state, first_token)
 
     def _decode_once_paged(self) -> None:
@@ -676,6 +821,178 @@ class ServingEngine:
         self.stats.decode_seconds += time.perf_counter() - t0
         self._emit_decoded(decoding, tokens)
 
+    # ------------------------------------------------------------------ speculation
+
+    def _spec_start(self, slot: int, prompt_ids: list[int]) -> None:
+        if self._ngram is not None:
+            self._ngram.start(slot, prompt_ids)
+        if self._draft is not None:
+            self._draft.start(slot, prompt_ids)
+
+    def _spec_stop(self, slot: int) -> None:
+        if self._ngram is not None:
+            self._ngram.stop(slot)
+        if self._draft is not None:
+            self._draft.stop(slot)
+
+    def _collect_drafts(self, decoding: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Gather up to K draft tokens per decoding slot from the configured source.
+        Returns (drafts [num_slots, K], num_drafts [num_slots]); a slot with 0 drafts
+        (no n-gram match, idle, mid-prefill) degrades to plain decode inside the same
+        verify step."""
+        k = self.draft_k
+        num = self.pool.num_slots
+        drafts = np.zeros((num, k), np.int32)
+        counts = np.zeros(num, np.int32)
+        if self._draft is not None:
+            # one jitted draft call for all slots: ingest the tokens committed since the
+            # drafter last saw each slot (<= K+1 of them: accepted + bonus), draft K
+            windows = np.full((num, k + 1), self.pad_token_id, np.int32)
+            ingest = np.zeros(num, np.int32)
+            for slot in decoding:
+                state = self._slot_states[slot]
+                committed = state.request.prompt_ids + state.tokens
+                fresh = committed[int(self._draft.seen[slot]) :]
+                assert len(fresh) <= k + 1, (len(fresh), k)
+                windows[slot, : len(fresh)] = fresh
+                ingest[slot] = len(fresh)
+            proposed = self._draft.propose(windows, ingest)
+            for slot in decoding:
+                drafts[slot] = proposed[slot]
+                counts[slot] = k
+        elif self._ngram is not None:
+            for slot in decoding:
+                proposal = self._ngram.propose(slot)
+                drafts[slot, : len(proposal)] = proposal
+                counts[slot] = len(proposal)
+        return drafts, counts
+
+    def _verify_once_paged(self) -> None:
+        decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
+        k = self.draft_k
+        drafts, num_drafts = self._collect_drafts(decoding)
+
+        page_size = self.pool.page_size
+        table = np.zeros_like(self.pool.page_table)
+        lengths = np.zeros(self.pool.num_slots, np.int32)
+        for slot in decoding:
+            state = self._slot_states[slot]
+            position = int(self.pool.lengths[slot])
+            # map pages under the verify window, capped at the request's worst-case
+            # token count (what admission reserved for): the window overhang past it
+            # scatters to trash — those drafts could never be committed anyway
+            total = len(state.request.prompt_ids) + state.request.max_new_tokens
+            last = min(position + k, total - 1)
+            for index in range(position // page_size, last // page_size + 1):
+                if self.pool.page_table[slot, index] == TRASH_PAGE:
+                    self.pool.alloc_page(slot, index)  # reservation makes this infallible
+            table[slot] = self.pool.page_table[slot]
+            lengths[slot] = position
+
+        tokens = np.zeros((self.pool.num_slots, k + 1), np.int32)
+        tokens[:, 0] = self._tokens
+        tokens[:, 1:] = drafts
+        t0 = time.perf_counter()
+        caches, accepted, bonus, new_rngs = self._verify_step(
+            self._variables,
+            self.pool.caches,
+            jnp.asarray(table),
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(num_drafts),
+            jnp.asarray(self._rngs),
+            jnp.asarray(self._do_sample),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+        )
+        self.pool.caches = caches
+        accepted = np.asarray(accepted)  # host fetch: the streaming sync point
+        bonus = np.asarray(bonus)
+        self._rngs = np.array(new_rngs)
+        self._step_count += 1
+        self.stats.decode_steps += 1
+        self.stats.decode_seconds += time.perf_counter() - t0
+        self._emit_verified(decoding, drafts, num_drafts, accepted, bonus)
+
+    def _verify_once_dense(self) -> None:
+        decoding = list(self._slot_states.keys())
+        k = self.draft_k
+        drafts, num_drafts = self._collect_drafts(decoding)
+        tokens = np.zeros((self.pool.num_slots, k + 1), np.int32)
+        tokens[:, 0] = self._tokens
+        tokens[:, 1:] = drafts
+        t0 = time.perf_counter()
+        caches, accepted, bonus, new_rngs = self._verify_step(
+            self._variables,
+            self.pool.caches,
+            jnp.asarray(tokens),
+            jnp.asarray(self.pool.lengths),
+            jnp.asarray(num_drafts),
+            jnp.asarray(self._rngs),
+            jnp.asarray(self._do_sample),
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+        )
+        self.pool.caches = caches
+        accepted = np.asarray(accepted)
+        bonus = np.asarray(bonus)
+        self._rngs = np.array(new_rngs)
+        self._step_count += 1
+        self.stats.decode_steps += 1
+        self.stats.decode_seconds += time.perf_counter() - t0
+        self._emit_verified(decoding, drafts, num_drafts, accepted, bonus)
+
+    def _emit_verified(
+        self,
+        decoding: list[int],
+        drafts: np.ndarray,
+        num_drafts: np.ndarray,
+        accepted: np.ndarray,
+        bonus: np.ndarray,
+    ) -> None:
+        """Commit a verify step's outcome per slot: deliver the accepted drafts in
+        order, then the bonus token, honoring EOS/budget mid-window (tokens after a
+        finishing token are DISCARDED — the stream matches non-speculative decode
+        exactly). The cache frontier advances past the fed token plus the accepted
+        drafts actually delivered; the bonus token's K/V is not written yet (it is the
+        next step's fed token), and rejected-tail writes stay masked behind the
+        frontier until the next window overwrites them."""
+        emitted_total = proposed_total = accepted_total = 0
+        for slot in decoding:
+            state = self._slot_states.get(slot)
+            if state is None:
+                continue
+            proposals = int(num_drafts[slot])
+            acc = min(int(accepted[slot]), proposals)
+            proposed_total += proposals
+            accepted_total += acc
+            plan = [int(drafts[slot, i]) for i in range(acc)] + [int(bonus[slot])]
+            eos = state.request.eos_token_id
+            budget = state.request.max_new_tokens - state.num_generated
+            emit: list[int] = []
+            for token in plan:
+                emit.append(token)
+                if (eos is not None and token == eos) or len(emit) >= budget:
+                    break
+            self.pool.lengths[slot] += 1 + min(len(emit), acc)
+            self._tokens[slot] = emit[-1]
+            emitted_total += len(emit)
+            for token in emit:
+                self._deliver(state, token)
+                if state.done:
+                    break
+        self.stats.decode_tokens += emitted_total
+        self.stats.draft_tokens_proposed += proposed_total
+        self.stats.draft_tokens_accepted += accepted_total
+        if emitted_total:
+            get_telemetry().count("serving_decode_tokens", emitted_total)
+        if proposed_total:
+            get_telemetry().count("serving_draft_tokens_proposed", proposed_total)
+        if accepted_total:
+            get_telemetry().count("serving_draft_tokens_accepted", accepted_total)
+
     # ------------------------------------------------------------------ shared internals
 
     def _emit_decoded(self, active: list[int], tokens: np.ndarray) -> None:
@@ -698,6 +1015,8 @@ class ServingEngine:
         """Stream one token and apply the per-request termination rules (EOS counts as an
         emitted token, matching `generation_utils._trim_after_eos` semantics)."""
         state.tokens.append(token)
+        if self._ngram is not None and state.slot is not None:
+            self._ngram.extend(state.slot, token)  # emitted tokens feed future lookups
         if state.request.on_token is not None:
             state.request.on_token(token)
         eos = state.request.eos_token_id
@@ -720,6 +1039,8 @@ class ServingEngine:
                 self._prefill_order.remove(slot)
             if self.prefix is not None:
                 self._register_prefix(state, slot)
+            if self.speculating:
+                self._spec_stop(slot)
             self.pool.free(slot)
             del self._slot_states[slot]
         if status == RequestStatus.completed:
@@ -759,6 +1080,14 @@ class ServingEngine:
             fragmentation = round(self.pool.page_fragmentation, 4)
             telemetry.gauge("serving/pages_in_use", pages_in_use)
             telemetry.gauge("serving/page_fragmentation", fragmentation)
+        accept_rate = accepted_per_step = None
+        if self.speculating:
+            rate = stats.accept_rate()
+            accept_rate = 0.0 if rate is None else round(rate, 4)
+            per_step = stats.accepted_tokens_per_step()
+            accepted_per_step = 0.0 if per_step is None else round(per_step, 3)
+            telemetry.gauge("serving/accept_rate", accept_rate)
+            telemetry.gauge("serving/accepted_tokens_per_step", accepted_per_step)
         ttft = stats.mean_ttft_s()
         prefill_rate = stats.prefill_tok_s()
         decode_rate = stats.decode_tok_s()
@@ -774,6 +1103,8 @@ class ServingEngine:
             ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
             prefill_tok_s=None if prefill_rate is None else round(prefill_rate, 1),
             decode_tok_s=None if decode_rate is None else round(decode_rate, 1),
+            accept_rate=accept_rate,
+            accepted_tokens_per_step=accepted_per_step,
             counters={
                 "admitted": stats.admitted,
                 "completed": stats.completed,
@@ -784,6 +1115,8 @@ class ServingEngine:
                 "decode_steps": stats.decode_steps,
                 "prefix_hit_tokens": stats.prefix_hit_tokens,
                 "prefix_miss_tokens": stats.prefix_miss_tokens,
+                "draft_tokens_proposed": stats.draft_tokens_proposed,
+                "draft_tokens_accepted": stats.draft_tokens_accepted,
             },
         )
 

@@ -126,6 +126,16 @@ class Scheduler:
     def queue_depth(self) -> int:
         return len(self.waiting)
 
+    def prefill_budget(self, decode_tokens: int) -> int:
+        """Prefill token budget for THIS step, with decode's token compute counted
+        against the shared per-step budget. Plain decode bills 1 token per active slot;
+        speculative decoding bills the whole verify window (K+1 tokens per slot) — the
+        verify step really does compute K+1 positions, so a step that verifies a lot
+        prefills less and the inter-token latency of running requests stays bounded as
+        speculation scales up. Floored at one 8-token lane so arrivals always make
+        progress even when decode alone exceeds `prefill_chunk_tokens`."""
+        return max(8, self.prefill_chunk_tokens - max(0, int(decode_tokens)))
+
     def submit(self, request: Request) -> RequestState:
         if len(self.waiting) >= self.max_waiting:
             raise QueueFullError(

@@ -1,0 +1,237 @@
+"""Draft-token sources for speculative decoding in the serving engine.
+
+Speculative decoding (Leviathan et al. 2023) turns decode's one-model-call-per-token
+into one call per *K+1* tokens: a cheap drafter proposes up to K continuation tokens per
+slot, the target model scores all of them in a single jitted verify step
+(`engine.ServingEngine._verify_impl*`), and the in-graph acceptance rule
+(`ops/sampling.speculative_accept`) commits the longest target-consistent prefix plus
+one bonus token. Two drafters live here, both proposing DETERMINISTIC tokens (point-mass
+q, so greedy outputs stay bit-exact and sampled outputs distribution-correct):
+
+- :class:`NgramDrafter` — model-free prompt-lookup / n-gram self-drafting: match the
+  slot's recent suffix against its OWN prompt+generation history and propose the tokens
+  that followed the previous occurrence. Zero extra FLOPs, pure host bookkeeping; wins
+  on repetitive workloads (code edits, summarization-with-quotes, RAG over the prompt,
+  degenerate loops) and proposes nothing when the suffix is novel — a slot with no
+  proposal degrades to plain decode inside the same verify step.
+- :class:`DraftModelDrafter` — any smaller supported checkpoint (the HF import path
+  makes these cheap) runs greedy autoregressive drafting against its OWN dense KV cache
+  pool, kept in lockstep with the target's committed tokens: each engine step one jitted
+  call ingests the tokens the target committed since last step (width K+1, per-row
+  counts) and scans K greedy draft steps. Draft-side speculative writes beyond the
+  committed frontier are masked stale data, overwritten by the next ingest — the same
+  rollback-by-frontier discipline the target's paged pool uses.
+
+Both drafters are slot-indexed by the engine's slot ids and host-driven; neither touches
+the target model's compiled programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import _insert_slot
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: propose the continuation of the most recent previous
+    occurrence of the slot's current suffix in its own history.
+
+    `ngram_max` down to `ngram_min` suffix lengths are tried longest-first; the match
+    must END before the current suffix (the suffix trivially matches itself and carries
+    no continuation). Proposals are capped at `draft_k` tokens and may be shorter (or
+    empty) near the history head — the verify step handles per-slot draft counts.
+    """
+
+    def __init__(self, draft_k: int, ngram_max: int = 3, ngram_min: int = 1) -> None:
+        assert draft_k >= 1, draft_k
+        assert 1 <= ngram_min <= ngram_max, (ngram_min, ngram_max)
+        self.draft_k = draft_k
+        self.ngram_max = ngram_max
+        self.ngram_min = ngram_min
+        self._history: dict[int, list[int]] = {}
+
+    def start(self, slot: int, prompt_ids: list[int]) -> None:
+        self._history[slot] = list(prompt_ids)
+
+    def extend(self, slot: int, token: int) -> None:
+        history = self._history.get(slot)
+        if history is not None:
+            history.append(token)
+
+    def stop(self, slot: int) -> None:
+        self._history.pop(slot, None)
+
+    def propose(self, slot: int) -> list[int]:
+        history = self._history.get(slot)
+        if not history:
+            return []
+        tokens = np.asarray(history, np.int64)
+        for n in range(self.ngram_max, self.ngram_min - 1, -1):
+            if len(tokens) <= n:
+                continue
+            suffix = tokens[-n:]
+            # windows h[i:i+n] for i < len-n (exclude the suffix's own occurrence)
+            windows = np.lib.stride_tricks.sliding_window_view(tokens[:-1], n)
+            matches = np.nonzero((windows == suffix).all(axis=1))[0]
+            if matches.size == 0:
+                continue
+            starts = matches + n
+            # prefer the most recent occurrence with a FULL K-token continuation: in a
+            # periodic history (the prompt-lookup sweet spot) the latest match ends at
+            # the tail and would truncate the proposal to a token or two
+            full = starts[starts <= len(tokens) - self.draft_k]
+            start = int(full[-1]) if full.size else int(starts[-1])
+            return [int(t) for t in tokens[start : start + self.draft_k]]
+        return []
+
+
+class DraftModelDrafter:
+    """A small greedy draft model shadowing the target's committed token stream.
+
+    The drafter owns per-slot dense KV rows for the DRAFT model (shapes are the draft's
+    head/layer geometry, independent of the target's paged pool) plus a `seen` counter:
+    how many committed tokens (prompt + delivered) of each slot are resident in the
+    draft cache. `propose` runs ONE jitted program over all slots — ingest the <= K+1
+    newly committed tokens at each row's own frontier, then scan K greedy single-token
+    draft steps — so drafting compiles once for the engine's lifetime, like the verify
+    step it feeds.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        *,
+        num_slots: int,
+        max_len: int,
+        draft_k: int,
+        pad_token_id: int = 0,
+        prefill_bucket_multiple: int = 64,
+        cache_dtype=None,
+    ) -> None:
+        assert draft_k >= 1, draft_k
+        self.model = model
+        self._variables = {"params": params} if "params" not in params else params
+        self.num_slots = num_slots
+        self.draft_k = draft_k
+        self.pad_token_id = pad_token_id
+        self.prefill_bucket_multiple = prefill_bucket_multiple
+        # headroom past the target's max_len: the K-step draft scan writes up to K-1
+        # speculative positions past the last committed token
+        self.max_len = max_len + draft_k
+        self.caches = model.init_kv_caches(num_slots, self.max_len, cache_dtype)
+        self.seen = np.zeros(num_slots, np.int32)  # committed tokens resident per slot
+        self._prefill_fns: dict[int, Any] = {}
+        self._insert_fns: dict[int, Any] = {}
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+
+    @property
+    def draft_compiles(self) -> int:
+        """Compiled variants of the combined ingest+scan draft step (invariant: 1)."""
+        return int(self._step_fn._cache_size())
+
+    # ---------------------------------------------------------------- lifecycle
+
+    def start(self, slot: int, prompt_ids: list[int]) -> None:
+        """Prefill the draft model over the slot's prompt (one bucketed whole-prompt
+        call — the draft is small, so this rides the target's admission latency)."""
+        prompt_len = len(prompt_ids)
+        multiple = self.prefill_bucket_multiple
+        bucket = min(-(-prompt_len // multiple) * multiple, self.max_len)
+        ids = np.full((1, bucket), self.pad_token_id, np.int32)
+        ids[0, :prompt_len] = prompt_ids
+        mask = np.zeros((1, bucket), np.int32)
+        mask[0, :prompt_len] = 1
+
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+
+            def prefill(variables, ids, mask):
+                position_ids = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                caches = self.model.init_kv_caches(1, bucket)
+                out = self.model.apply(
+                    variables,
+                    ids,
+                    position_ids=position_ids,
+                    attention_mask=mask,
+                    kv_caches=caches,
+                    cache_index=0,
+                )
+                return out.kv_caches
+
+            fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        prefill_caches = fn(self._variables, jnp.asarray(ids), jnp.asarray(mask))
+
+        insert = self._insert_fns.get(bucket)
+        if insert is None:
+            insert = self._insert_fns[bucket] = jax.jit(_insert_slot, donate_argnums=(0,))
+        self.caches = insert(self.caches, prefill_caches, slot)
+        self.seen[slot] = prompt_len
+
+    def stop(self, slot: int) -> None:
+        """Release a slot: stale K/V stays (masked), the next start() overwrites it."""
+        self.seen[slot] = 0
+
+    # ---------------------------------------------------------------- drafting
+
+    def propose(self, windows: np.ndarray, counts: np.ndarray) -> np.ndarray:
+        """Run the draft step: ingest each row's `counts` newly committed tokens
+        (``windows`` [num_slots, K+1], right-padded) at its `seen` frontier, then draft
+        K greedy tokens. Rows with count 0 (idle / mid-prefill slots) write only masked
+        garbage and their drafts are ignored by the caller. Advances `seen` by `counts`.
+        Returns drafts [num_slots, K] int32 (host array)."""
+        caches, drafts = self._step_fn(
+            self._variables,
+            self.caches,
+            jnp.asarray(windows, jnp.int32),
+            jnp.asarray(counts, jnp.int32),
+            jnp.asarray(self.seen, jnp.int32),
+        )
+        self.caches = caches
+        self.seen += counts.astype(np.int32)
+        return np.asarray(drafts)
+
+    def _step_impl(self, variables, caches, windows, counts, lengths):
+        k = self.draft_k
+        width = k + 1
+        positions = lengths[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+        out = self.model.apply(
+            variables,
+            windows,
+            position_ids=positions,
+            kv_caches=caches,
+            cache_index=lengths,
+        )
+        # logits at each row's last REAL ingested token condition on the full committed
+        # history — the draft's distribution for the first proposal
+        last_index = jnp.maximum(counts - 1, 0)
+        last = jnp.take_along_axis(out.logits, last_index[:, None, None], axis=1)[:, 0]
+        first_draft = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        caches = out.kv_caches
+
+        def step(carry, i):
+            caches, token = carry
+            pos = lengths + counts + i  # [S]: the draft token's own cache position
+            o = self.model.apply(
+                variables,
+                token[:, None],
+                position_ids=pos[:, None],
+                kv_caches=caches,
+                cache_index=pos,
+            )
+            nxt = jnp.argmax(o.logits[:, -1], axis=-1).astype(jnp.int32)
+            return (o.kv_caches, nxt), token
+
+        (caches, last_draft), fed = jax.lax.scan(
+            step, (caches, first_draft), jnp.arange(k - 1)
+        )
+        if k == 1:
+            drafts = first_draft[:, None]
+        else:
+            drafts = jnp.concatenate([fed.T, last_draft[:, None]], axis=1)  # [S, K]
+        return caches, drafts

@@ -107,6 +107,11 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         "ttft_ms",
         "prefill_tok_s",
         "decode_tok_s",
+        # speculative decoding (null when speculation is off): cumulative fraction of
+        # proposed draft tokens the target accepted, and accepted drafts per verify step
+        # (emitted tokens per step is this + 1)
+        "accept_rate",
+        "accepted_tokens_per_step",
         "counters",
     ),
 }
@@ -138,6 +143,11 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     # hit / (hit + miss), rendered by tools/telemetry_summary.py
     "serving_prefix_hit_tokens",
     "serving_prefix_miss_tokens",
+    # speculative decoding (serving/engine.py): draft tokens proposed by the configured
+    # drafter (n-gram lookup or draft model) vs accepted by the jitted verify step —
+    # accept rate is accepted / proposed, rendered by tools/telemetry_summary.py
+    "serving_draft_tokens_proposed",
+    "serving_draft_tokens_accepted",
 )
 
 KNOWN_EVENTS: tuple[str, ...] = (
@@ -163,6 +173,10 @@ KNOWN_GAUGES: tuple[str, ...] = (
     # index, and the fraction of allocated page capacity not holding valid tokens
     "serving/pages_in_use",
     "serving/page_fragmentation",
+    # speculative decoding (serving/engine.py): cumulative draft acceptance rate and
+    # accepted draft tokens per verify step (only written when speculation is enabled)
+    "serving/accept_rate",
+    "serving/accepted_tokens_per_step",
 )
 
 # goodput buckets, in reporting order; "other" is the window remainder (python overhead,

@@ -37,6 +37,19 @@ def main() -> None:
         "emits a BENCH-trajectory JSON line with the slot-capacity ratio",
     )
     p.add_argument(
+        "--speculate",
+        action="store_true",
+        help="A/B speculative decoding (n-gram self-drafting) against plain decode on a "
+        "repetitive-text workload: decode tokens/s ratio + accepted-tokens/step; emits "
+        "a BENCH-trajectory JSON line with spec_decode_tokens_per_s_ratio",
+    )
+    p.add_argument(
+        "--draft-k",
+        type=int,
+        default=8,
+        help="draft tokens per step for --speculate (K >= 1)",
+    )
+    p.add_argument(
         "--seq2seq",
         action="store_true",
         help="bench enc_dec_dolomite decode instead: --prompt is the ENCODER length; the "
@@ -57,7 +70,10 @@ def main() -> None:
     config_dict = dict(
         model_type=model_type,
         vocab_size=50304 if backend == "tpu" else 512,
-        n_positions=args.prompt + args.new,
+        # CPU headroom past the tiny prompt+new: the --speculate workload needs a longer
+        # decode budget to reach its steady state (rope: positions are compute-only, so
+        # this costs no params/HBM and leaves the other benches' shapes untouched)
+        n_positions=args.prompt + args.new if backend == "tpu" else max(args.prompt + args.new, 256),
         n_embd=args.n_embd,
         n_layer=args.n_layer,
         n_head=args.n_embd // 64,
@@ -164,8 +180,24 @@ def main() -> None:
             record["paged_ab"] = _bench_paged_ab(
                 model, params, config, args, short_len, record["engine"]
             )
+        if args.speculate:
+            record["speculate_ab"] = _bench_speculate_ab(model, params, config, args)
 
     print(json.dumps(record))
+
+    if not args.seq2seq and args.speculate:
+        spec = record["speculate_ab"]
+        print(
+            json.dumps(
+                {
+                    "metric": "spec_decode_tokens_per_s_ratio",
+                    "value": spec["decode_tok_s_ratio"],
+                    "unit": "x plain decode tok/s on the repetitive-text workload",
+                    "vs_baseline": spec["decode_tok_s_ratio"],
+                    "accepted_tokens_per_step": spec["accepted_tokens_per_step"],
+                }
+            )
+        )
 
     if not args.seq2seq and args.paged:
         ratio = record["paged_ab"]["capacity"]["sustainable_slots_ratio"]
@@ -239,6 +271,84 @@ def _bench_engine(model, params, config, args, short_len: int, paged: bool = Tru
         "prefill_tok_s": round(stats.prefill_tok_s() or 0.0, 1),
         "decode_tok_s": round(stats.decode_tok_s() or 0.0, 1),
         "decode_compiles": engine.decode_compiles,
+    }
+
+
+def _bench_speculate_ab(model, params, config, args) -> dict:
+    """Speculative vs plain decode on a REPETITIVE-TEXT workload — the regime n-gram
+    self-drafting targets (quoting/copying from the prompt, templated continuations;
+    greedy decode of small models also converges to repetition loops, which prompt
+    lookup rides for free). Same requests, same engine geometry, greedy decode; the only
+    difference is `speculate_ngram`. Decode tok/s comes from each engine's own
+    accounting (EngineStats), so prefill cost is excluded from the ratio."""
+    import numpy as np
+
+    from dolomite_engine_tpu.serving import EngineStats, ServingEngine, serve_batch
+
+    backend_tpu = jax.default_backend() == "tpu"
+    multiple = 64 if backend_tpu else 16
+    page_size = 64 if backend_tpu else 16
+    # a repeated phrase as the prompt, a decode budget long enough for lookup to engage;
+    # both sized inside the model's n_positions (the tiny CPU config is only 64 wide)
+    rs = np.random.RandomState(23)
+    phrase = list(map(int, rs.randint(3, config.vocab_size, 12)))
+    prompt_len = max(min(args.prompt // 2, config.n_positions // 4), 14)
+    prompt = (phrase * (-(-prompt_len // len(phrase))))[:prompt_len]
+    bucket = -(-len(prompt) // multiple) * multiple
+    new_tokens = min(max(4 * args.new, 128), config.n_positions - bucket)
+    max_len = bucket + new_tokens
+
+    def run(speculate: bool) -> tuple[dict, "ServingEngine"]:
+        engine = ServingEngine(
+            model,
+            params,
+            num_slots=args.batch,
+            max_len=max_len,
+            prefill_bucket_multiple=multiple,
+            max_waiting=4 * args.batch,
+            eos_token_id=None,  # full decode budget: pure throughput timing
+            pad_token_id=config.pad_token_id,
+            page_size=page_size,
+            speculate_ngram=speculate,
+            draft_k=args.draft_k,
+        )
+        specs = [
+            dict(prompt_ids=list(prompt), max_new_tokens=new_tokens)
+            for _ in range(args.batch)
+        ]
+        serve_batch(engine, [dict(s) for s in specs])  # compile warmup
+        engine.stats = EngineStats()  # measure steady-state only
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            serve_batch(engine, [dict(s) for s in specs])
+        e2e = (time.perf_counter() - t0) / args.reps
+        stats = engine.stats
+        return {
+            "e2e_s": round(e2e, 4),
+            "decode_tok_s": round(stats.decode_tok_s() or 0.0, 1),
+            "decode_steps": stats.decode_steps,
+            "decode_tokens": stats.decode_tokens,
+        }, engine
+
+    baseline, _ = run(speculate=False)
+    speculated, engine = run(speculate=True)
+    stats = engine.stats
+    return {
+        "workload": {
+            "prompt": len(prompt),
+            "phrase": len(phrase),
+            "max_new_tokens": new_tokens,
+            "requests": args.batch,
+            "draft_k": args.draft_k,
+        },
+        "baseline": baseline,
+        "speculated": speculated,
+        "decode_tok_s_ratio": round(
+            speculated["decode_tok_s"] / max(baseline["decode_tok_s"], 1e-9), 3
+        ),
+        "accept_rate": round(stats.accept_rate() or 0.0, 4),
+        "accepted_tokens_per_step": round(stats.accepted_tokens_per_step() or 0.0, 3),
+        "verify_compiles": engine.verify_compiles,
     }
 
 

@@ -38,6 +38,23 @@ def parse_args() -> argparse.Namespace:
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--num-slots", type=int, default=4, help="max concurrent requests")
     p.add_argument("--bucket-multiple", type=int, default=64, help="prefill width bucket")
+    p.add_argument(
+        "--speculate-ngram",
+        action="store_true",
+        help="speculative decoding via n-gram/prompt-lookup self-drafting (no extra "
+        "model; mutually exclusive with --draft-model)",
+    )
+    p.add_argument(
+        "--draft-model",
+        default=None,
+        help="smaller dolomite-format checkpoint that drafts for the target",
+    )
+    p.add_argument(
+        "--draft-k",
+        type=int,
+        default=4,
+        help="draft tokens proposed per engine step (K >= 1)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--stream",
@@ -84,6 +101,15 @@ def main() -> None:
     pad_token_id = next(
         (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
     )
+    draft_model = draft_params = None
+    if args.draft_model:
+        draft_wrapper = ModelWrapperForFinetuning(
+            mode=Mode.inference, model_name=args.draft_model
+        )
+        draft_params = draft_wrapper.load_pretrained_params(
+            args.draft_model, MeshManager.get_mesh()
+        )
+        draft_model = draft_wrapper.model
     engine = ServingEngine(
         model.model,
         params,
@@ -93,6 +119,10 @@ def main() -> None:
         eos_token_id=model.eos_token_id,
         pad_token_id=pad_token_id,
         rng=jax.random.PRNGKey(args.seed),
+        speculate_ngram=args.speculate_ngram,
+        draft_model=draft_model,
+        draft_params=draft_params,
+        draft_k=args.draft_k,
     )
 
     sampling = SamplingParams(

@@ -62,6 +62,24 @@ def parse_args() -> argparse.Namespace:
         action="store_true",
         help="disable prefix caching (page-aligned prompt prefix reuse)",
     )
+    p.add_argument(
+        "--speculate-ngram",
+        action="store_true",
+        help="speculative decoding via n-gram/prompt-lookup self-drafting (no extra "
+        "model; mutually exclusive with --draft-model)",
+    )
+    p.add_argument(
+        "--draft-model",
+        default=None,
+        help="smaller dolomite-format checkpoint that drafts for the target "
+        "(speculative decoding; must share the target's tokenizer)",
+    )
+    p.add_argument(
+        "--draft-k",
+        type=int,
+        default=4,
+        help="draft tokens proposed per engine step (K >= 1)",
+    )
     p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
     p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
     p.add_argument("--seed", type=int, default=0)
@@ -99,6 +117,16 @@ def main() -> None:
         telemetry = Telemetry(sink_path=args.telemetry_sink)
         install_telemetry(telemetry)
 
+    draft_model = draft_params = None
+    if args.draft_model:
+        draft_wrapper = ModelWrapperForFinetuning(
+            mode=Mode.inference, model_name=args.draft_model
+        )
+        draft_params = draft_wrapper.load_pretrained_params(
+            args.draft_model, MeshManager.get_mesh()
+        )
+        draft_model = draft_wrapper.model
+
     prompt_ids = [
         model.tokenizer(text, add_special_tokens=False)["input_ids"] for text in prompts
     ]
@@ -127,6 +155,10 @@ def main() -> None:
         num_pages=args.num_pages,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         prefix_caching=not args.no_prefix_cache,
+        speculate_ngram=args.speculate_ngram,
+        draft_model=draft_model,
+        draft_params=draft_params,
+        draft_k=args.draft_k,
     )
 
     sampling = SamplingParams(
@@ -177,6 +209,16 @@ def main() -> None:
     prefill_rate = stats.prefill_tok_s()
     decode_rate = stats.decode_tok_s()
     hit_rate = stats.prefix_hit_rate()
+    spec_info = ""
+    if engine.speculating:
+        accept = stats.accept_rate()
+        per_step = stats.accepted_tokens_per_step()
+        spec_info = (
+            f", speculation accept rate={'n/a' if accept is None else f'{accept:.1%}'} "
+            f"({stats.draft_tokens_accepted}/{stats.draft_tokens_proposed} drafts, "
+            f"{0.0 if per_step is None else per_step:.2f} accepted/step, "
+            f"verify compiles={engine.verify_compiles})"
+        )
     paged_info = ""
     if engine.paged:
         paged_info = (
@@ -194,7 +236,7 @@ def main() -> None:
         f"decode={'n/a' if decode_rate is None else f'{decode_rate:.0f}'} tok/s, "
         f"decode compiles={engine.decode_compiles}, "
         f"free slots={engine.pool.num_free}/{engine.pool.num_slots}"
-        f"{paged_info}",
+        f"{spec_info}{paged_info}",
         file=sys.stderr,
     )
 

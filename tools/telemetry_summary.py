@@ -248,6 +248,16 @@ def summarize(records: list[dict]) -> str:
                 f"prefix hit rate {100.0 * hit / (hit + miss):.1f}% "
                 f"({hit}/{hit + miss} prompt tokens reused)"
             )
+        proposed = counters.get("draft_tokens_proposed", 0)
+        if proposed > 0:
+            accepted = counters.get("draft_tokens_accepted", 0)
+            spec = (
+                f"speculation accept rate {100.0 * accepted / proposed:.1f}% "
+                f"({accepted}/{proposed} drafts)"
+            )
+            if last.get("accepted_tokens_per_step") is not None:
+                spec += f", {last['accepted_tokens_per_step']:.2f} accepted/step"
+            parts.append(spec)
         if last.get("pages_in_use") is not None:
             page_line = f"pages {last['pages_in_use']}/{last.get('pages_total', '?')}"
             if last.get("page_fragmentation") is not None:
