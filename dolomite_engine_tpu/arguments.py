@@ -29,6 +29,7 @@ from .enums import (
     ExperimentsTrackerName,
     FP8Backend,
     GradientCheckpointingMethod,
+    KernelBackend,
     LossMask,
     LRDecaySchedule,
     Mode,
@@ -610,6 +611,38 @@ class FaultToleranceArgs(BaseArgs):
         ), "dataloader_stall_timeout_seconds must be positive or None"
 
 
+class KernelArgs(BaseArgs):
+    """Per-op-family lowering backend (ops/pallas/config.py KernelConfig; docs/PERFORMANCE.md
+    "Kernel tier"). ``xla`` everywhere is the default and the numerical reference; ``pallas``
+    swaps in the hand-written TPU kernel for that family. The YAML block is installed
+    process-wide by the entry points and beats the ``DOLOMITE_KERNELS`` env override; a
+    build without Pallas silently degrades back to XLA (capability probe in
+    `utils/packages.py`)."""
+
+    # full-sequence causal attention: GQA-native splash kernel vs legacy flash/sdpa
+    splash_attention: KernelBackend = KernelBackend.xla
+    # serving decode/verify attention straight off the paged KV pool's page table
+    paged_attention: KernelBackend = KernelBackend.xla
+    # fused RMSNorm(+residual add) inside the transformer block
+    rmsnorm: KernelBackend = KernelBackend.xla
+    # grouped-GEMM MoE dispatch (sort-by-expert segment GEMMs) for the dense + EP paths
+    moe_dispatch: KernelBackend = KernelBackend.xla
+
+    def install(self) -> None:
+        """Make this block the process-wide kernel selection (entry points call this
+        right after arg parsing, before any model trace)."""
+        from .ops.pallas import install_kernel_config
+
+        install_kernel_config(
+            {
+                "splash_attention": self.splash_attention,
+                "paged_attention": self.paged_attention,
+                "rmsnorm": self.rmsnorm,
+                "moe_dispatch": self.moe_dispatch,
+            }
+        )
+
+
 class TrainingArgs(BaseArgs):
     # randomization related arguments
     random_args: RandomArgs = RandomArgs()
@@ -641,6 +674,8 @@ class TrainingArgs(BaseArgs):
     research_args: ResearchArgs = ResearchArgs()
     # fault tolerance: preemption checkpointing, NaN/stall guards, checkpoint I/O retry
     fault_tolerance_args: FaultToleranceArgs = FaultToleranceArgs()
+    # per-op-family kernel backend selection (Pallas tier; docs/PERFORMANCE.md)
+    kernel_args: KernelArgs = KernelArgs()
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(
@@ -749,6 +784,8 @@ class InferenceArgs(BaseArgs):
     mixed_precision_args: MixedPrecisionArgs = MixedPrecisionArgs()
     # logging related arguments
     logging_args: LoggingArgs = LoggingArgs()
+    # per-op-family kernel backend selection (Pallas tier; docs/PERFORMANCE.md)
+    kernel_args: KernelArgs = KernelArgs()
     # output dir
     output_dir: str = None
 

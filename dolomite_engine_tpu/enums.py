@@ -122,6 +122,18 @@ class FIMMode(Enum):
     spm = "spm"
 
 
+class KernelBackend(Enum):
+    """Lowering backend for one op family (`ops/pallas/config.py` KernelConfig).
+
+    ``xla`` is always the default and the numerical reference: the op lowers through
+    plain XLA (einsums, gathers, fused sdpa). ``pallas`` swaps in the hand-written TPU
+    kernel from `ops/pallas/` for that family — opt-in per family, benchmark-gated, and
+    parity-tested in interpret mode on CPU (docs/PERFORMANCE.md "Kernel tier")."""
+
+    xla = "xla"
+    pallas = "pallas"
+
+
 # MoE compute-path names. Not an Enum: configs also accept None (model default) and the
 # reference spelling "scattermoe" (configs/testing/scattermoe.yml), normalized here once for
 # the arguments validator, the model wrapper, and the model's dispatch.

@@ -404,6 +404,9 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
         TuningMethod.lora,
     ), "finetune requires a finetuning tuning method"
 
+    # kernel-backend selection must be installed before any model trace (Pallas tier)
+    args.kernel_args.install()
+
     init_distributed(timeout_minutes=args.distributed_args.timeout_minutes)
 
     import transformers

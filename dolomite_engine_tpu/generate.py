@@ -231,6 +231,9 @@ def main(args: InferenceArgs | None = None) -> None:
     if args is None:
         args = get_args(mode)
 
+    # kernel-backend selection must be installed before any model trace (Pallas tier)
+    args.kernel_args.install()
+
     if not MeshManager.is_initialized():
         MeshManager()
 

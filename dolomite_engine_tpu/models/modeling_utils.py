@@ -35,6 +35,7 @@ from ..enums import AttentionImplementation
 from ..ops.activations import get_activation_function, is_glu
 from ..ops.attention import attention as attention_op
 from ..ops.normalization import check_normalization_function, layernorm, rmsnorm
+from ..ops.pallas import use_pallas
 from ..ops.rope import RoPEParams, apply_rotary_pos_emb, get_cos_sin
 from .config import CommonConfig
 from .enums import InitMethod, PositionEmbeddingType
@@ -158,14 +159,23 @@ class ParameterizedEmbedding(nn.Module):
 
 
 class Norm(nn.Module):
-    """layernorm / rmsnorm with fp32 accumulation (reference `modeling_utils/normalization/`)."""
+    """layernorm / rmsnorm with fp32 accumulation (reference `modeling_utils/normalization/`).
+
+    Called with `residual`, computes ``norm(x + residual)`` and returns
+    ``(normed, x + residual)`` so the caller can thread the sum on as its new residual
+    stream — the pre-norm block's "add then re-read" pattern collapsed into one op. With
+    the ``rmsnorm`` kernel family on the Pallas backend (`ops/pallas/config.py`) that
+    pair lowers to the fused RMSNorm(+residual) kernel; otherwise the XLA lowering is
+    bitwise identical to the unfused ``residual + x`` / ``rmsnorm`` sequence."""
 
     normalization_function: str = "layernorm"
     eps: float = 1e-5
     dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, residual: jax.Array | None = None
+    ) -> jax.Array | tuple[jax.Array, jax.Array]:
         check_normalization_function(self.normalization_function)
         dim = x.shape[-1]
         weight = self.param(
@@ -175,6 +185,16 @@ class Norm(nn.Module):
             jnp.float32,
         )
         if self.normalization_function == "rmsnorm":
+            if use_pallas("rmsnorm") and (
+                residual is None
+                or (residual.shape == x.shape and residual.dtype == x.dtype)
+            ):
+                from ..ops.pallas.rmsnorm import fused_rmsnorm
+
+                return fused_rmsnorm(x, weight, self.eps, residual=residual)
+            if residual is not None:
+                x = x + residual
+                return rmsnorm(x, weight, self.eps), x
             return rmsnorm(x, weight, self.eps)
         bias = self.param(
             "bias",
@@ -182,6 +202,9 @@ class Norm(nn.Module):
             (dim,),
             jnp.float32,
         )
+        if residual is not None:
+            x = x + residual
+            return layernorm(x, weight, bias, self.eps), x
         return layernorm(x, weight, bias, self.eps)
 
 
@@ -320,6 +343,68 @@ def _update_paged_kv_cache(
     return k_view, v_view, kv_cache, attention_mask, cache_index
 
 
+def _paged_kernel_eligible(
+    kv_cache: KVCache | None,
+    cache_index,
+    attention_mask,
+    segment_ids,
+    alibi_bias,
+    causal: bool,
+    dropout: float,
+) -> bool:
+    """Whether this attention call is the serving decode/verify step the ragged Pallas
+    kernel handles: paged cache, per-row [B] frontier vector, plain causal attention
+    (no incoming padding mask, segments, alibi, or dropout). The engine's decode and
+    K+1 verify programs are exactly this shape; everything else (chunked prefill with
+    its pad mask, dense caches, training) stays on the XLA gather path."""
+    return (
+        kv_cache is not None
+        and "page_table" in kv_cache
+        and getattr(cache_index, "ndim", 0) == 1
+        and attention_mask is None
+        and segment_ids is None
+        and alibi_bias is None
+        and causal
+        and dropout == 0.0
+        and use_pallas("paged_attention")
+    )
+
+
+def _paged_pallas_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    kv_cache: KVCache,
+    cache_index: jax.Array,
+    softmax_scale: float,
+) -> tuple[jax.Array, KVCache]:
+    """Decode/verify attention straight off the page table: scatter the new K/V into
+    their pages exactly like `_update_paged_kv_cache` (bit-identical pool state), then
+    let the ragged kernel (`ops/pallas/paged_attention.py`) read K/V through the table —
+    no ``[B, max_pages * page_size]`` gathered view, traffic scales with each row's
+    resident tokens instead of the worst case."""
+    from ..ops.attention import paged_scatter_kv
+    from ..ops.pallas.paged_attention import paged_decode_attention
+
+    table = kv_cache["page_table"]
+    page_size = kv_cache["k"].shape[1]
+    seq = key.shape[1]
+    view_len = table.shape[1] * page_size
+
+    positions = (
+        cache_index[:, None] + jnp.arange(seq, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int32)
+    in_range = positions < view_len
+    positions = jnp.where(in_range, positions, 0)
+    k_pages = paged_scatter_kv(kv_cache["k"], key, table, positions, in_range)
+    v_pages = paged_scatter_kv(kv_cache["v"], value, table, positions, in_range)
+
+    out = paged_decode_attention(
+        query, k_pages, v_pages, table, cache_index, softmax_scale
+    )
+    return out, {"k": k_pages, "v": v_pages, "page_table": table}
+
+
 class Attention(nn.Module):
     """Self-attention with fused QKV, RoPE/alibi, KV cache, all head types."""
 
@@ -387,9 +472,25 @@ class Attention(nn.Module):
             query = apply_rotary_pos_emb(query, cos, sin)
             key = apply_rotary_pos_emb(key, cos, sin)
 
+        softmax_scale = get_softmax_scale(config, head_dim)
+        attn_pdrop = 0.0 if deterministic else config.attn_pdrop
+
         query_offset = 0
         if kv_cache is not None:
             assert cache_index is not None
+            # the kernel accumulates scores and softmax in fp32 (the eager-reference
+            # numerics), so a config that opts out of fp32 softmax stays on XLA
+            if config.attention_softmax_in_fp32 and _paged_kernel_eligible(
+                kv_cache, cache_index, attention_mask, segment_ids, alibi_bias,
+                self.causal, attn_pdrop,
+            ):
+                out, kv_cache = _paged_pallas_attention(
+                    query, key, value, kv_cache, cache_index, softmax_scale
+                )
+                out = out.reshape(batch, seq, num_heads * head_dim)
+                out = c_proj(out)
+                out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)
+                return out, kv_cache
             # prefill fast path ONLY when the write position is STATICALLY zero and the
             # chunk is multi-token (generation_utils passes cache_index=0 as a python int):
             # attending over the just-written LOCAL k/v is then exactly cache[0:seq], and
@@ -415,10 +516,7 @@ class Attention(nn.Module):
                     key, value, kv_cache, cache_index, attention_mask
                 )
 
-        softmax_scale = get_softmax_scale(config, head_dim)
-
         dropout_rng = None
-        attn_pdrop = 0.0 if deterministic else config.attn_pdrop
         if attn_pdrop > 0.0:
             dropout_rng = self.make_rng("dropout")
 
@@ -637,14 +735,14 @@ class Block(nn.Module):
         )
         if m_residual is not None:
             attn_out = attn_out * m_residual
-        hidden_states = residual + attn_out
-
-        residual = hidden_states
-        h = get_norm(config, self.dtype, "ln_2")(hidden_states)
+        # ln_2 over the residual-fused form: hidden_states comes back as
+        # attn_out + residual (bitwise the old two-step add), and with the rmsnorm
+        # kernel family on Pallas the pair is one fused kernel (ops/pallas/rmsnorm.py)
+        h, hidden_states = get_norm(config, self.dtype, "ln_2")(attn_out, residual=residual)
         mlp_out = MLP(config=config, dtype=self.dtype, name="mlp")(h, deterministic=deterministic)
         if m_residual is not None:
             mlp_out = mlp_out * m_residual
-        hidden_states = residual + mlp_out
+        hidden_states = hidden_states + mlp_out
 
         hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")

@@ -25,6 +25,7 @@ from flax import linen as nn
 from ..parallel.sharding import logical_constraint
 
 from ..enums import AttentionImplementation, normalize_moe_implementation
+from ..ops.pallas import use_pallas
 from ..ops.activations import get_activation_function, is_glu
 from ..ops.moe import (
     combine_weights,
@@ -219,6 +220,23 @@ class SparseMoE(nn.Module):
                 MeshManager.get_mesh(),
                 capacity_factor=capacity_factor,
             )
+        elif use_pallas("moe_dispatch"):
+            # grouped-GEMM kernel tier (ops/pallas/moe.py): replaces BOTH dense
+            # single-device paths — same dropless sort-by-expert semantics as "scatter",
+            # hand-written segment GEMMs instead of the generic einsum/ragged_dot lowering
+            from ..ops.pallas.moe import experts_grouped
+
+            out = experts_grouped(
+                x.astype(self.dtype),
+                router_weights,
+                selected_experts,
+                w_fc,
+                b_fc,
+                w_proj,
+                b_proj,
+                act,
+                config.num_experts,
+            )
         elif impl == "scatter":
             out = experts_ragged(
                 x.astype(self.dtype),
@@ -284,10 +302,9 @@ class SparseMoEBlock(nn.Module):
         )
         if m_residual is not None:
             attn_out = attn_out * m_residual
-        hidden_states = residual + attn_out
-
-        residual = hidden_states
-        h = get_norm(config, self.dtype, "ln_2")(hidden_states)
+        # residual-fused ln_2 (see modeling_utils.Block): one fused RMSNorm(+add) kernel
+        # when the rmsnorm family runs on Pallas, bitwise-identical XLA otherwise
+        h, hidden_states = get_norm(config, self.dtype, "ln_2")(attn_out, residual=residual)
         moe_out, router_logits = SparseMoE(
             config=config,
             dtype=self.dtype,
@@ -297,7 +314,7 @@ class SparseMoEBlock(nn.Module):
         )(h, deterministic=deterministic)
         if m_residual is not None:
             moe_out = moe_out * m_residual
-        hidden_states = residual + moe_out
+        hidden_states = hidden_states + moe_out
 
         hidden_states = logical_constraint(
             hidden_states, ("act_batch", "act_seq", "act_embed")

@@ -178,10 +178,13 @@ def _use_splash_kernel() -> bool:
     """Opt-in switch for the splash-attention kernel (the production MaxText kernel: GQA
     without KV-head repetition, fused bwd option). Numerics are pinned by tests in interpret
     mode; it stays opt-in until measured against the legacy flash kernel on hardware
-    (PROFILE.md pending list)."""
-    import os
+    (PROFILE.md pending list). Selection lives in the central KernelConfig
+    (`ops/pallas/config.py` — ``kernel_args`` block / ``DOLOMITE_KERNELS``; the legacy
+    ``DOLOMITE_SPLASH_ATTENTION=1`` spelling still works as an env alias), which also
+    folds in the one cached capability probe (`utils/packages.is_pallas_available`)."""
+    from .pallas import use_pallas
 
-    return os.environ.get("DOLOMITE_SPLASH_ATTENTION", "0") == "1"
+    return use_pallas("splash_attention")
 
 
 def _tpu_splash_attention(

@@ -76,8 +76,34 @@ def experts_eager(
     """Dense all-experts compute: every expert runs on every token, weighted by `combine`.
 
     x: [T, d]; combine: [T, E] (zero for unselected experts); w_fc: [E, d, f];
-    w_proj: [E, f, d]. Shards over "ep" via the expert axis of the einsums.
+    w_proj: [E, f, d]. This path is NOT expert-parallel: on an ep > 1 mesh the einsums
+    all-gather every expert bank onto every device (distributed experts go through
+    `experts_ep_a2a`, dispatched by models/moe_dolomite.py). It is the single-device
+    numerical reference the ragged / grouped-GEMM (`ops/pallas/moe.py`) paths are pinned
+    against.
+
+    The `[E, d, f]` / `[E, f, d]` bank layout below is a contract shared with the
+    Pallas grouped-GEMM kernel (its BlockSpecs index expert banks on axis 0 and contract
+    axis 1 against the incoming rows) — asserted here so a transposed import surfaces at
+    the reference path too, not just inside the kernel.
     """
+    assert w_fc.ndim == 3 and w_proj.ndim == 3, (w_fc.shape, w_proj.shape)
+    num_experts, hidden, fc_out = w_fc.shape
+    intermediate = w_proj.shape[1]
+    # GLU activations emit [up | gate], so c_fc's output axis is f or 2f
+    assert w_proj.shape == (num_experts, intermediate, hidden) and fc_out in (
+        intermediate,
+        2 * intermediate,
+    ), (
+        f"w_proj {w_proj.shape} must be the [E, f, d] partner of w_fc {w_fc.shape} "
+        f"([E, d, f] or [E, d, 2f] for GLU)"
+    )
+    assert x.shape[-1] == hidden and combine.shape == (x.shape[0], num_experts), (
+        x.shape,
+        combine.shape,
+        w_fc.shape,
+    )
+    assert x.dtype == w_fc.dtype == w_proj.dtype, (x.dtype, w_fc.dtype, w_proj.dtype)
     h = jnp.einsum("td,edf->etf", x, w_fc)
     if b_fc is not None:
         h = h + b_fc[:, None, :]
@@ -146,24 +172,43 @@ def _local_expert_compute(
     num_local_experts: int,
 ) -> jax.Array:
     """Grouped GEMM over rows tagged with a local expert id; id == num_local_experts marks an
-    empty slot (routed to a zero-padded dummy bank so `ragged_dot` group sizes stay exact)."""
+    empty slot (routed to a zero-padded dummy bank so the group sizes stay exact). With the
+    ``moe_dispatch`` family on the Pallas backend the two `ragged_dot`s are replaced by the
+    grouped-GEMM kernel (`ops/pallas/moe.py` grouped_mlp) over the same sorted layout, so
+    the EP all_to_all path rides the kernel tier too."""
     order = jnp.argsort(expert_ids, stable=True)
     group_sizes = jnp.bincount(expert_ids, length=num_local_experts + 1).astype(jnp.int32)
 
     w_fc_pad = jnp.concatenate([w_fc, jnp.zeros_like(w_fc[:1])], axis=0)
     w_proj_pad = jnp.concatenate([w_proj, jnp.zeros_like(w_proj[:1])], axis=0)
+    b_fc_pad = (
+        None if b_fc is None else jnp.concatenate([b_fc, jnp.zeros_like(b_fc[:1])], axis=0)
+    )
+    b_proj_pad = (
+        None
+        if b_proj is None
+        else jnp.concatenate([b_proj, jnp.zeros_like(b_proj[:1])], axis=0)
+    )
 
     xs = jnp.take(x, order, axis=0)
     ids_sorted = jnp.take(expert_ids, order)
-    h = jax.lax.ragged_dot(xs, w_fc_pad, group_sizes)
-    if b_fc is not None:
-        b_fc_pad = jnp.concatenate([b_fc, jnp.zeros_like(b_fc[:1])], axis=0)
-        h = h + jnp.take(b_fc_pad, ids_sorted, axis=0)
-    h = act(h)
-    y = jax.lax.ragged_dot(h, w_proj_pad, group_sizes)
-    if b_proj is not None:
-        b_proj_pad = jnp.concatenate([b_proj, jnp.zeros_like(b_proj[:1])], axis=0)
-        y = y + jnp.take(b_proj_pad, ids_sorted, axis=0)
+
+    from .pallas import use_pallas
+
+    if use_pallas("moe_dispatch"):
+        from .pallas.moe import grouped_mlp
+
+        y = grouped_mlp(
+            xs, ids_sorted, group_sizes, w_fc_pad, b_fc_pad, w_proj_pad, b_proj_pad, act
+        )
+    else:
+        h = jax.lax.ragged_dot(xs, w_fc_pad, group_sizes)
+        if b_fc_pad is not None:
+            h = h + jnp.take(b_fc_pad, ids_sorted, axis=0)
+        h = act(h)
+        y = jax.lax.ragged_dot(h, w_proj_pad, group_sizes)
+        if b_proj_pad is not None:
+            y = y + jnp.take(b_proj_pad, ids_sorted, axis=0)
     # dummy-slot rows are zero already (zero-padded banks, zero-padded bias); the mask keeps
     # that invariant explicit rather than depending on the padding
     y = jnp.where((ids_sorted < num_local_experts)[:, None], y, 0.0)

@@ -1,0 +1,40 @@
+"""Hand-written TPU kernel tier (ROADMAP item: benchmark-gated Pallas layer).
+
+Three kernels, each behind a per-family switch in :mod:`.config` with the plain-XLA
+lowering as the default and numerical reference:
+
+- :mod:`.paged_attention` — ragged paged-attention decode: serving decode/verify reads
+  K/V through the page table, skipping unmapped pages and padded positions instead of
+  gather-then-mask;
+- :mod:`.rmsnorm` — fused RMSNorm(+residual add) inside the transformer block;
+- :mod:`.moe` — grouped-GEMM MoE dispatch (sort-by-expert, block-padded segment GEMMs,
+  scatter-combine) replacing the dense all-experts einsum.
+
+Only the config surface is imported eagerly; kernel modules import
+`jax.experimental.pallas` and load lazily behind :func:`.config.use_pallas`, so a build
+without Pallas still imports this package. Every kernel runs in interpret mode off-TPU
+(`utils/packages.pallas_interpret_mode`), which is how the CPU tier-1 parity suite in
+`tests/ops/test_pallas_kernels.py` pins the numerics.
+"""
+
+from .config import (
+    KERNEL_FAMILIES,
+    KernelConfig,
+    active_kernel_backends,
+    get_kernel_config,
+    install_kernel_config,
+    kernel_backend,
+    kernel_overrides,
+    use_pallas,
+)
+
+__all__ = [
+    "KERNEL_FAMILIES",
+    "KernelConfig",
+    "active_kernel_backends",
+    "get_kernel_config",
+    "install_kernel_config",
+    "kernel_backend",
+    "kernel_overrides",
+    "use_pallas",
+]

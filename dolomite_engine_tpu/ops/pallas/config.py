@@ -1,0 +1,147 @@
+"""Central kernel-backend selection: ONE KernelConfig instead of scattered env sniffing.
+
+Every hand-written kernel in this package sits behind a per-op-family switch with the
+plain-XLA lowering as the default and the numerical reference:
+
+- ``splash_attention``: the GQA-native Pallas splash kernel for full-sequence causal
+  attention (`ops/attention.py` — previously the ad-hoc ``DOLOMITE_SPLASH_ATTENTION`` env
+  sniff, still honored as a legacy alias).
+- ``paged_attention``: the ragged paged-attention decode kernel (`paged_attention.py`) —
+  serving decode/verify reads K/V straight through the page table instead of
+  gather-then-mask.
+- ``rmsnorm``: the fused RMSNorm(+residual add) kernel (`rmsnorm.py`) inside the
+  transformer block.
+- ``moe_dispatch``: the grouped-GEMM MoE dispatch (`moe.py`) replacing the dense
+  all-experts einsum.
+
+Selection precedence: an explicitly installed config (``install_kernel_config`` — wired
+from the ``kernel_args`` block in `arguments.py` by the CLI entry points) beats the
+``DOLOMITE_KERNELS`` env var, which beats the all-XLA default. The env var is a comma
+list of ``family=backend`` pairs; a bare family name means ``pallas``::
+
+    DOLOMITE_KERNELS=paged_attention,rmsnorm=pallas python tools/serve.py ...
+
+Call sites gate on :func:`use_pallas`, which also folds in the capability probe
+(`utils/packages.is_pallas_available`) so a build without Pallas degrades to XLA instead
+of crashing. Tests override per-family via the :func:`kernel_overrides` context manager.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, fields, replace
+
+from ...enums import KernelBackend
+
+KERNEL_FAMILIES = ("splash_attention", "paged_attention", "rmsnorm", "moe_dispatch")
+
+
+@dataclass(frozen=True)
+class KernelConfig:
+    """Backend per op family; ``xla`` everywhere is the numerics-reference default."""
+
+    splash_attention: KernelBackend = KernelBackend.xla
+    paged_attention: KernelBackend = KernelBackend.xla
+    rmsnorm: KernelBackend = KernelBackend.xla
+    moe_dispatch: KernelBackend = KernelBackend.xla
+
+
+assert tuple(f.name for f in fields(KernelConfig)) == KERNEL_FAMILIES
+
+_LOCK = threading.Lock()
+_INSTALLED: KernelConfig | None = None
+
+
+def _coerce_backend(value) -> KernelBackend:
+    if isinstance(value, KernelBackend):
+        return value
+    try:
+        return KernelBackend(str(value))
+    except ValueError:
+        raise ValueError(
+            f"unknown kernel backend '{value}' (expected one of "
+            f"{[b.value for b in KernelBackend]})"
+        ) from None
+
+
+def _config_from_env() -> KernelConfig:
+    overrides: dict[str, KernelBackend] = {}
+    spec = os.environ.get("DOLOMITE_KERNELS", "")
+    for item in filter(None, (part.strip() for part in spec.split(","))):
+        family, sep, backend = item.partition("=")
+        family = family.strip()
+        if family not in KERNEL_FAMILIES:
+            raise ValueError(
+                f"DOLOMITE_KERNELS names unknown kernel family '{family}' "
+                f"(expected one of {KERNEL_FAMILIES})"
+            )
+        overrides[family] = _coerce_backend(backend.strip()) if sep else KernelBackend.pallas
+    # legacy opt-in spelling from the splash-attention PR, kept working
+    if os.environ.get("DOLOMITE_SPLASH_ATTENTION", "0") == "1":
+        overrides.setdefault("splash_attention", KernelBackend.pallas)
+    return KernelConfig(**overrides)
+
+
+def get_kernel_config() -> KernelConfig:
+    """The active config: installed > ``DOLOMITE_KERNELS`` env > all-XLA default."""
+    installed = _INSTALLED
+    return installed if installed is not None else _config_from_env()
+
+
+def install_kernel_config(config: KernelConfig | dict | None) -> None:
+    """Install the process-wide config (None reverts to env/default resolution).
+
+    Accepts a mapping of family -> backend-name too, which is how the ``kernel_args``
+    block from `arguments.py` arrives."""
+    global _INSTALLED
+    if config is not None and not isinstance(config, KernelConfig):
+        unknown = set(config) - set(KERNEL_FAMILIES)
+        if unknown:
+            raise ValueError(
+                f"unknown kernel famil{'ies' if len(unknown) > 1 else 'y'} "
+                f"{sorted(unknown)} (expected one of {KERNEL_FAMILIES})"
+            )
+        config = KernelConfig(
+            **{name: _coerce_backend(value) for name, value in config.items()}
+        )
+    with _LOCK:
+        _INSTALLED = config
+
+
+def kernel_backend(family: str) -> KernelBackend:
+    return getattr(get_kernel_config(), family)
+
+
+def use_pallas(family: str) -> bool:
+    """True when `family` is configured for Pallas AND the Pallas build probe passes."""
+    if kernel_backend(family) is not KernelBackend.pallas:
+        return False
+    from ...utils.packages import is_pallas_available
+
+    return is_pallas_available()
+
+
+def active_kernel_backends() -> dict[str, str]:
+    """family -> backend-name map of what would lower right now (telemetry `run_start`
+    and `serving` records; "pallas" is reported only when the probe passes)."""
+    return {
+        family: (KernelBackend.pallas if use_pallas(family) else KernelBackend.xla).value
+        for family in KERNEL_FAMILIES
+    }
+
+
+@contextmanager
+def kernel_overrides(**families: str | KernelBackend):
+    """Temporarily override per-family backends (tests, benchmark A/Bs); restores the
+    previously installed config — including "nothing installed" — on exit."""
+    previous = _INSTALLED
+    base = get_kernel_config()
+    install_kernel_config(
+        replace(base, **{name: _coerce_backend(value) for name, value in families.items()})
+    )
+    try:
+        yield
+    finally:
+        install_kernel_config(previous)

@@ -499,6 +499,9 @@ def main(mode: Mode = Mode.training, args: TrainingArgs | None = None) -> None:
         args.tuning_args.tuning_method == TuningMethod.pretraining
     ), "pretraining requires tuning_method = pretraining"
 
+    # kernel-backend selection must be installed before any model trace (Pallas tier)
+    args.kernel_args.install()
+
     init_distributed(timeout_minutes=args.distributed_args.timeout_minutes)
 
     import transformers

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.pallas import active_kernel_backends
 from ..ops.sampling import sample_tokens_vectorized, speculative_accept
 from ..utils.telemetry import get_telemetry
 from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
@@ -1105,6 +1106,7 @@ class ServingEngine:
             decode_tok_s=None if decode_rate is None else round(decode_rate, 1),
             accept_rate=accept_rate,
             accepted_tokens_per_step=accepted_per_step,
+            kernels=active_kernel_backends(),
             counters={
                 "admitted": stats.admitted,
                 "completed": stats.completed,

@@ -40,9 +40,11 @@ from .mixed_precision import dtype_to_string, normalize_dtype_string, string_to_
 from .packages import (
     is_aim_available,
     is_colorlog_available,
+    is_pallas_available,
     is_torch_available,
     is_transformers_available,
     is_wandb_available,
+    pallas_interpret_mode,
 )
 from .pydantic import BaseArgs
 from .retry import TRANSIENT_IO_ERRORS, retry_io

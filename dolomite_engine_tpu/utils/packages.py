@@ -34,3 +34,36 @@ def is_transformers_available() -> bool:
 def is_torch_available() -> bool:
     # only used by HF-interop converters for reading torch-format checkpoints
     return _is_available("torch")
+
+
+# ---------------------------------------------------------------------- Pallas / TPU
+# The ONE capability probe every kernel call site consumes (ops/attention.py splash,
+# ops/pallas/*): probed once per process instead of per-call try-imports.
+
+
+@cache
+def is_pallas_available() -> bool:
+    """Whether `jax.experimental.pallas` (+ the TPU dialect) imports in this build."""
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+@cache
+def pallas_interpret_mode() -> bool:
+    """Whether Pallas kernels must run in interpret mode on this backend.
+
+    True off-TPU (CPU tier-1 parity tests, local debugging), False on real TPUs where
+    Mosaic compiles the kernel. ``DOLOMITE_PALLAS_INTERPRET=1`` forces interpret mode on
+    TPU too (kernel debugging without leaving the pod). Cached: the backend cannot change
+    mid-process."""
+    import os
+
+    if os.environ.get("DOLOMITE_PALLAS_INTERPRET", "") == "1":
+        return True
+    import jax
+
+    return jax.default_backend() != "tpu"

@@ -77,6 +77,10 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         "jax_version",
         "jaxlib_version",
         "config_hash",
+        # family -> backend map of what each op family lowers through
+        # (ops/pallas/config.active_kernel_backends; "pallas" only when the build
+        # probe passes, so the record reflects what actually ran)
+        "kernels",
     ),
     "step": ("step", "t"),
     "window": (
@@ -112,6 +116,9 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         # (emitted tokens per step is this + 1)
         "accept_rate",
         "accepted_tokens_per_step",
+        # active kernel backend per op family (ops/pallas/config.py) — which lowering
+        # produced these serving numbers, for kernel A/B attribution
+        "kernels",
         "counters",
     ),
 }
@@ -424,6 +431,8 @@ class Telemetry:
         except Exception:
             jaxlib_version = None
         device_kinds = sorted({d.device_kind for d in jax.local_devices()})
+        from ..ops.pallas import active_kernel_backends
+
         # host/pid/versions/config hash make runs attributable post-hoc: which machine,
         # which software, which exact resolved config produced this sink
         self._emit(
@@ -439,6 +448,7 @@ class Telemetry:
                 "jax_version": jax.__version__,
                 "jaxlib_version": jaxlib_version,
                 "config_hash": config_hash,
+                "kernels": active_kernel_backends(),
             }
         )
 

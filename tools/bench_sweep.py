@@ -4,6 +4,17 @@ Usage: python tools/bench_sweep.py --n_embd 2048 --n_layer 16 --micro_bs 8 --ckp
 
 Prints one JSON line per run with mfu/step_time/HBM. Used to tune bench.py toward the
 >=0.40 MFU north star (BASELINE.md); findings recorded in PROFILE.md.
+
+Kernel-tier A/B mode (docs/PERFORMANCE.md "Kernel tier"):
+
+    python tools/bench_sweep.py --kernels [--kernel_families rmsnorm,moe_dispatch]
+
+runs each Pallas kernel family against its XLA reference lowering on the family's hot
+shape (decode-shaped paged attention, block-shaped rmsnorm rows, token-batch MoE
+dispatch) and prints one ``{"bench": "kernel_ab", "family": ...}`` JSON line per family
+for the BENCH trajectory. Off-TPU the Pallas side runs in interpret mode — numbers then
+measure the emulator, not the kernel (the ``interpret`` field says which you got), so
+only TPU lines are meaningful as speedups; CPU runs exist to keep the harness exercised.
 """
 
 import argparse
@@ -19,6 +30,135 @@ import jax.numpy as jnp
 import numpy as np
 
 _PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
+
+KERNEL_AB_FAMILIES = ("paged_attention", "rmsnorm", "moe_dispatch")
+
+
+def _time_jitted(fn, args, reps: int) -> float:
+    """Median wall ms of an already-jitted callable (one warmup compile call)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)) * 1e3
+
+
+def _bench_kernel_family(family: str, args) -> dict:
+    """One xla-vs-pallas A/B on the family's hot shape; returns the JSON payload."""
+    from dolomite_engine_tpu.ops.pallas import kernel_overrides
+
+    key = jax.random.PRNGKey(0)
+    if family == "rmsnorm":
+        rows, hidden = args.micro_bs * 512, args.n_embd
+        x = jax.random.normal(key, (rows, hidden), jnp.bfloat16)
+        r = jax.random.normal(jax.random.PRNGKey(1), (rows, hidden), jnp.bfloat16)
+        w = jnp.ones((hidden,), jnp.float32)
+        from dolomite_engine_tpu.ops.normalization import rmsnorm
+        from dolomite_engine_tpu.ops.pallas.rmsnorm import fused_rmsnorm
+
+        xla_fn = jax.jit(lambda x, r: rmsnorm(x + r, w, 1e-5))
+        pallas_fn = jax.jit(lambda x, r: fused_rmsnorm(x, w, 1e-5, residual=r)[0])
+        shape = {"rows": rows, "hidden": hidden}
+        operands = (x, r)
+    elif family == "moe_dispatch":
+        tokens, d, f, E, k = args.micro_bs * 512, args.n_embd, 2 * args.n_embd, 8, 2
+        x = jax.random.normal(key, (tokens, d), jnp.bfloat16)
+        w_fc = jax.random.normal(jax.random.PRNGKey(1), (E, d, f), jnp.bfloat16) * 0.02
+        w_proj = jax.random.normal(jax.random.PRNGKey(2), (E, f, d), jnp.bfloat16) * 0.02
+        logits = jax.random.normal(jax.random.PRNGKey(3), (tokens, E), jnp.float32)
+        from dolomite_engine_tpu.ops.moe import combine_weights, experts_eager, route
+        from dolomite_engine_tpu.ops.pallas.moe import experts_grouped
+
+        weights, selected = route(logits, k)
+        weights = weights.astype(x.dtype)
+
+        def run_xla(x):
+            combine = combine_weights(weights, selected, E)
+            return experts_eager(x, combine, w_fc, None, w_proj, None, jax.nn.gelu)
+
+        xla_fn = jax.jit(run_xla)
+        pallas_fn = jax.jit(
+            lambda x: experts_grouped(
+                x, weights, selected, w_fc, None, w_proj, None, jax.nn.gelu, E
+            )
+        )
+        shape = {"tokens": tokens, "d": d, "f": f, "experts": E, "top_k": k}
+        operands = (x,)
+    elif family == "paged_attention":
+        # decode-shaped: many slots, 1 query token each, ragged resident lengths
+        slots, page, max_pages, hq, hkv, hd = args.micro_bs * 4, 16, 32, 8, 2, 64
+        num_pages = slots * max_pages + 1
+        q = jax.random.normal(key, (slots, 1, hq, hd), jnp.bfloat16)
+        k_pages = jax.random.normal(
+            jax.random.PRNGKey(1), (num_pages, page, hkv, hd), jnp.bfloat16
+        )
+        v_pages = jax.random.normal(
+            jax.random.PRNGKey(2), (num_pages, page, hkv, hd), jnp.bfloat16
+        )
+        rs = np.random.RandomState(0)
+        lengths = jnp.asarray(rs.randint(1, max_pages * page - 1, slots), jnp.int32)
+        table = jnp.asarray(
+            1 + np.arange(slots * max_pages, dtype=np.int32).reshape(slots, max_pages)
+        )
+        scale = hd**-0.5
+        from dolomite_engine_tpu.ops.attention import (
+            eager_attention,
+            make_attention_mask,
+            paged_gather_kv,
+        )
+        from dolomite_engine_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+        def run_xla(q, k_pages, v_pages):
+            view_len = max_pages * page
+            valid = jnp.arange(view_len)[None, :] < (lengths[:, None] + 1)
+            mask = make_attention_mask(
+                slots, 1, view_len, causal=True,
+                attention_mask=valid.astype(jnp.int32), query_offset=lengths,
+            )
+            return eager_attention(
+                q, paged_gather_kv(k_pages, table), paged_gather_kv(v_pages, table),
+                mask, None, scale,
+            )
+
+        xla_fn = jax.jit(run_xla)
+        pallas_fn = jax.jit(
+            lambda q, k, v: paged_decode_attention(q, k, v, table, lengths, scale)
+        )
+        shape = {
+            "slots": slots, "page_size": page, "max_pages": max_pages,
+            "q_heads": hq, "kv_heads": hkv, "head_dim": hd,
+        }
+        operands = (q, k_pages, v_pages)
+    else:
+        raise ValueError(f"unknown kernel family for A/B: {family}")
+
+    from dolomite_engine_tpu.utils import pallas_interpret_mode
+
+    xla_ms = _time_jitted(xla_fn, operands, args.steps)
+    with kernel_overrides(**{family: "pallas"}):
+        pallas_ms = _time_jitted(pallas_fn, operands, args.steps)
+    return {
+        "bench": "kernel_ab",
+        "family": family,
+        "backend": jax.default_backend(),
+        "interpret": pallas_interpret_mode(),
+        **shape,
+        "xla_ms": round(xla_ms, 3),
+        "pallas_ms": round(pallas_ms, 3),
+        "pallas_speedup": round(xla_ms / pallas_ms, 3) if pallas_ms else None,
+    }
+
+
+def run_kernel_ab(args) -> None:
+    families = [
+        f.strip() for f in (args.kernel_families or ",".join(KERNEL_AB_FAMILIES)).split(",")
+        if f.strip()
+    ]
+    for family in families:
+        print(json.dumps(_bench_kernel_family(family, args)), flush=True)
 
 
 def main() -> None:
@@ -60,7 +200,17 @@ def main() -> None:
                    help="scan_layers: nn.scan over one block (or k-block groups with --ckpt k)")
     p.add_argument("--windows", type=int, default=1,
                    help="timing windows of --steps each; reports the median window")
+    p.add_argument("--kernels", action="store_true",
+                   help="kernel-tier A/B mode: per-family xla-vs-pallas JSON lines "
+                        "instead of the train-step sweep")
+    p.add_argument("--kernel_families", type=str, default=None,
+                   help="comma list of families for --kernels "
+                        f"(default: {','.join(KERNEL_AB_FAMILIES)})")
     args = p.parse_args()
+
+    if args.kernels:
+        run_kernel_ab(args)
+        return
 
     if args.splash:
         os.environ["DOLOMITE_SPLASH_ATTENTION"] = "1"

@@ -61,11 +61,27 @@ def parse_args() -> argparse.Namespace:
         action="store_true",
         help="print tokens as they decode (single prompt only)",
     )
+    p.add_argument(
+        "--kernels",
+        default=None,
+        help="kernel families to run on Pallas, comma list of family[=backend] "
+        "(docs/PERFORMANCE.md 'Kernel tier'); e.g. --kernels paged_attention,rmsnorm",
+    )
     return p.parse_args()
 
 
 def main() -> None:
     args = parse_args()
+    if args.kernels:
+        from dolomite_engine_tpu.ops.pallas import install_kernel_config
+
+        install_kernel_config(
+            {
+                (item.partition("=")[0].strip()): (item.partition("=")[2].strip() or "pallas")
+                for item in args.kernels.split(",")
+                if item.strip()
+            }
+        )
 
     prompts = list(args.prompt)
     if args.prompt_file:

@@ -85,11 +85,30 @@ def parse_args() -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--output", help="write JSONL here instead of stdout")
     p.add_argument("--telemetry-sink", help="serving telemetry JSONL path")
+    p.add_argument(
+        "--kernels",
+        default=None,
+        help="kernel families to run on Pallas, comma list of family[=backend] "
+        "(docs/PERFORMANCE.md 'Kernel tier'); e.g. --kernels paged_attention,rmsnorm",
+    )
     return p.parse_args()
+
+
+def _install_kernels(spec: str | None) -> None:
+    if not spec:
+        return
+    from dolomite_engine_tpu.ops.pallas import install_kernel_config
+
+    overrides = {}
+    for item in filter(None, (part.strip() for part in spec.split(","))):
+        family, sep, backend = item.partition("=")
+        overrides[family.strip()] = backend.strip() if sep else "pallas"
+    install_kernel_config(overrides)  # validates family/backend names
 
 
 def main() -> None:
     args = parse_args()
+    _install_kernels(args.kernels)
 
     prompts = list(args.prompt)
     if args.prompt_file:

@@ -166,6 +166,18 @@ def summarize(records: list[dict]) -> str:
                 f"jax {first.get('jax_version', '?')}/{first.get('jaxlib_version', '?')}, "
                 f"config {first.get('config_hash') or 'n/a'}"
             )
+        kernels = first.get("kernels")
+        if kernels:
+            # only call out non-default (non-xla) families; all-XLA is the baseline
+            pallas = sorted(k for k, v in kernels.items() if v != "xla")
+            lines.append(
+                "kernels: "
+                + (
+                    f"pallas [{', '.join(pallas)}], xla elsewhere"
+                    if pallas
+                    else "xla (all families)"
+                )
+            )
         lines.append("")
 
     if run_ends:
@@ -258,6 +270,10 @@ def summarize(records: list[dict]) -> str:
             if last.get("accepted_tokens_per_step") is not None:
                 spec += f", {last['accepted_tokens_per_step']:.2f} accepted/step"
             parts.append(spec)
+        serving_kernels = last.get("kernels") or {}
+        serving_pallas = sorted(k for k, v in serving_kernels.items() if v != "xla")
+        if serving_pallas:
+            parts.append(f"pallas kernels [{', '.join(serving_pallas)}]")
         if last.get("pages_in_use") is not None:
             page_line = f"pages {last['pages_in_use']}/{last.get('pages_total', '?')}"
             if last.get("page_fragmentation") is not None:
