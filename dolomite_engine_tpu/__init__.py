@@ -8,3 +8,13 @@ collectives replace NCCL calls, optax replaces torch.optim, and Orbax replaces t
 """
 
 __version__ = "0.1.0"
+
+import jax as _jax
+
+# Sharded-from-birth init (distributed.create_sharded_train_state jits model.init with sharded
+# out_shardings) must produce the SAME weights on every topology — single-chip, CPU virtual
+# meshes, pods. The legacy non-partitionable threefry materializes per-shard streams that
+# depend on the output sharding, so the same seed gave different models per mesh; the
+# partitionable implementation is sharding-invariant (and avoids a replicated random tensor
+# on TPU). jax enables this by default from 0.5; pin it on for the 0.4.x the image ships.
+_jax.config.update("jax_threefry_partitionable", True)

@@ -353,7 +353,7 @@ class MixedPrecisionArgs(BaseArgs):
     # dtype to use for training / inference
     dtype: str = "fp32"
     # fp8 backend (accepted for config compat; TPU fp8 rides XLA fp8 dots)
-    fp8_backend: FP8Backend | None = None
+    fp8_backend: FP8Backend | None = None  # dolint: disable=config-dead-field (compat knob; TPU fp8 always rides XLA fp8 dots)
 
     def model_post_init(self, __context: Any) -> None:
         self.dtype = normalize_dtype_string(self.dtype)
@@ -385,11 +385,11 @@ class DistributedArgs(BaseArgs):
     # ZeRO stage (0 = DDP, 1/2 = opt-state sharding, 3 = full param sharding)
     stage: int = 3
     # distributed backend; torch/deepspeed are coerced to jax
-    distributed_backend: DistributedBackend = DistributedBackend.jax
+    distributed_backend: DistributedBackend = DistributedBackend.jax  # dolint: disable=config-dead-field (coerced to jax in model_post_init; kept for reference-YAML compat)
     # overlap communication with computation (XLA latency-hiding scheduler)
-    overlap_comm: bool = False
+    overlap_comm: bool = False  # dolint: disable=config-dead-field (XLA's latency-hiding scheduler already overlaps; accepted no-op)
     # accepted no-op (GPU memory layout knob)
-    contiguous_gradients: bool = False
+    contiguous_gradients: bool = False  # dolint: disable=config-dead-field (GPU memory-layout knob; accepted no-op)
     # CPU offloading: optimizer state lives in pinned host memory (ZeRO-Offload
     # equivalent; distributed/__init__.py get_state_shardings)
     cpu_offload: bool = False
@@ -400,12 +400,12 @@ class DistributedArgs(BaseArgs):
     # zero topology
     zero_topology: ZeroTopologyArgs = ZeroTopologyArgs()
     # ZeRO++ knobs: accepted no-ops on TPU
-    zero_quantized_weights: bool = False
-    zero_quantized_gradients: bool = False
+    zero_quantized_weights: bool = False  # dolint: disable=config-dead-field (ZeRO++ knob; accepted no-op on TPU)
+    zero_quantized_gradients: bool = False  # dolint: disable=config-dead-field (ZeRO++ knob; accepted no-op on TPU)
     # communication dtype
-    communication_dtype: str | None = None
+    communication_dtype: str | None = None  # dolint: disable=config-dead-field (GSPMD chooses collective dtypes; normalized + accepted for compat)
     # accepted no-op: XLA always compiles
-    torch_compile: bool = False
+    torch_compile: bool = False  # dolint: disable=config-dead-field (XLA always compiles; accepted no-op)
     # single-host-storage mode: only process 0 reads the corpus; batches broadcast over
     # the interconnect (data/dataloader.py DispatchingDataLoader). Default: per-host
     # sharded feed (ShardedDataLoader), which is strictly better on shared storage
@@ -425,7 +425,7 @@ class DistributedArgs(BaseArgs):
     # distributed timeout in minutes
     timeout_minutes: int | None = None
     # accepted no-op (FSDP1 vs FSDP2 is meaningless under GSPMD)
-    fsdp_algorithm: int = 1
+    fsdp_algorithm: int = 1  # dolint: disable=config-dead-field (FSDP1-vs-2 is meaningless under GSPMD; accepted no-op)
 
     def model_post_init(self, __context: Any) -> None:
         if self.distributed_backend != DistributedBackend.jax:

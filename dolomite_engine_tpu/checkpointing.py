@@ -356,9 +356,19 @@ def _tree_subtree_keys(tree, subtree: str) -> list:
 def _partial_restore(state_path: str, abstract_subtree: dict):
     """Restore only the given subtrees of a saved TrainState (orbax partial restore)."""
     checkpointer = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-    return checkpointer.restore(
-        state_path, args=ocp.args.PyTreeRestore(item=abstract_subtree, partial_restore=True)
-    )
+    try:
+        restore_args = ocp.args.PyTreeRestore(item=abstract_subtree, partial_restore=True)
+    except TypeError:
+        # pre-partial_restore orbax (0.7.x): an empty transforms dict selects the
+        # transformation path, where `item`'s structure defines the output and checkpoint
+        # keys without a counterpart are dropped — same partial-restore semantics. That
+        # path requires explicit per-leaf restore args (sharding/shape/dtype).
+        restore_args = ocp.args.PyTreeRestore(
+            item=abstract_subtree,
+            transforms={},
+            restore_args=ocp.checkpoint_utils.construct_restore_args(abstract_subtree),
+        )
+    return checkpointer.restore(state_path, args=restore_args)
 
 
 def _zero_schedule_step(opt_state):
@@ -457,7 +467,12 @@ def load_checkpoint_for_training(
                 fp8=state.fp8,
             )
 
-    if load_args.load_optimizer and not load_args.resume_learning_rate:
+    # the LR schedule's only state here is the schedule step inside opt_state (optax), so
+    # "don't load the lr scheduler" and "don't resume the learning rate" both mean: restore
+    # the moments but restart the schedule from step 0
+    if load_args.load_optimizer and not (
+        load_args.resume_learning_rate and load_args.load_lr_scheduler
+    ):
         restored = TrainState(
             step=restored.step,
             params=restored.params,

@@ -28,6 +28,17 @@ from ..train_utils import TrainState
 
 def build_mesh_from_args(args) -> Mesh:
     dist = args.distributed_args
+    if dist.data_parallel_size is not None:
+        # redundant under SPMD (derived from device count / tp / cp), so treat the
+        # reference field as a topology assertion instead of silently ignoring a lie
+        derived = get_data_parallel_world_size(args)
+        if dist.data_parallel_size != derived:
+            raise ValueError(
+                f"distributed_args.data_parallel_size={dist.data_parallel_size} does not "
+                f"match the derived data-parallel world size {derived} "
+                f"({jax.device_count()} devices / tp={dist.tensor_parallel_size} / "
+                f"cp={dist.context_parallel_size})"
+            )
     MeshManager(
         tensor_parallel_size=dist.tensor_parallel_size,
         sequence_parallel_size=dist.context_parallel_size,

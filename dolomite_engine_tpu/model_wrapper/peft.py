@@ -39,12 +39,16 @@ class ModelWrapperForPEFT(ModelWrapperForFinetuning):
                 targets=targets,
             )
         elif self.tuning_method == TuningMethod.prompt_tuning:
+            from ..arguments import PromptTuningInit
             from ..peft.prompt_tuning import PromptTuningCausalLM
 
+            # the init mode selects the initializer explicitly (arguments.py validates
+            # that TEXT <=> init text present, but don't rely on that coupling here)
+            text_init = self.prompt_tuning_args.prompt_tuning_init == PromptTuningInit.TEXT
             self.model = PromptTuningCausalLM(
                 base_model=self.model,
                 num_virtual_tokens=self.prompt_tuning_args.num_virtual_tokens,
-                init_text=self.prompt_tuning_args.prompt_tuning_init_text,
+                init_text=self.prompt_tuning_args.prompt_tuning_init_text if text_init else None,
                 tokenizer=self.tokenizer,
             )
 

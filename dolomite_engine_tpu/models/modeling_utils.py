@@ -63,7 +63,7 @@ class ParameterizedLinear(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         kernel = self.param(
             "kernel",
-            nn.with_partitioning(_normal_init(self.std), self.kernel_axes),
+            nn.with_logical_partitioning(_normal_init(self.std), self.kernel_axes),
             (x.shape[-1], self.features),
             jnp.float32,
         )
@@ -83,7 +83,7 @@ class ParameterizedLinear(nn.Module):
         if self.use_bias:
             bias = self.param(
                 "bias",
-                nn.with_partitioning(nn.initializers.zeros_init(), (self.kernel_axes[-1],)),
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), (self.kernel_axes[-1],)),
                 (self.features,),
                 jnp.float32,
             )
@@ -96,13 +96,13 @@ class ParameterizedLinear(nn.Module):
         if lora is not None:
             lora_a = self.param(
                 "lora_a",
-                nn.with_partitioning(_normal_init(x.shape[-1] ** -0.5), (self.kernel_axes[0], None)),
+                nn.with_logical_partitioning(_normal_init(x.shape[-1] ** -0.5), (self.kernel_axes[0], None)),
                 (x.shape[-1], lora.rank),
                 jnp.float32,
             )
             lora_b = self.param(
                 "lora_b",
-                nn.with_partitioning(nn.initializers.zeros_init(), (None, self.kernel_axes[-1])),
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), (None, self.kernel_axes[-1])),
                 (lora.rank, self.features),
                 jnp.float32,
             )
@@ -130,7 +130,7 @@ class ParameterizedEmbedding(nn.Module):
     def __call__(self, ids: jax.Array) -> jax.Array:
         embedding = self.param(
             "embedding",
-            nn.with_partitioning(_normal_init(self.std), self.embedding_axes),
+            nn.with_logical_partitioning(_normal_init(self.std), self.embedding_axes),
             (self.num_embeddings, self.features),
             jnp.float32,
         )
@@ -180,7 +180,7 @@ class Norm(nn.Module):
         dim = x.shape[-1]
         weight = self.param(
             "weight",
-            nn.with_partitioning(nn.initializers.ones_init(), (None,)),
+            nn.with_logical_partitioning(nn.initializers.ones_init(), (None,)),
             (dim,),
             jnp.float32,
         )
@@ -198,7 +198,7 @@ class Norm(nn.Module):
             return rmsnorm(x, weight, self.eps)
         bias = self.param(
             "bias",
-            nn.with_partitioning(nn.initializers.zeros_init(), (None,)),
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), (None,)),
             (dim,),
             jnp.float32,
         )

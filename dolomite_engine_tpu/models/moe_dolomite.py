@@ -61,7 +61,7 @@ class ParameterizedExperts(nn.Module):
 
         kernel = self.param(
             "kernel",
-            nn.with_partitioning(init, self.kernel_axes),
+            nn.with_logical_partitioning(init, self.kernel_axes),
             (self.num_experts, in_features, self.features),
             jnp.float32,
         )
@@ -69,7 +69,7 @@ class ParameterizedExperts(nn.Module):
         if self.use_bias:
             bias = self.param(
                 "bias",
-                nn.with_partitioning(
+                nn.with_logical_partitioning(
                     nn.initializers.zeros_init(), (self.kernel_axes[0], self.kernel_axes[-1])
                 ),
                 (self.num_experts, self.features),
@@ -205,7 +205,7 @@ class SparseMoE(nn.Module):
         if impl == "ep_a2a":
             ep = MeshManager.axis_size("ep")
             capacity_factor = (
-                float(ep) if self.ep_capacity_factor is None else self.ep_capacity_factor
+                float(ep) if self.ep_capacity_factor is None else self.ep_capacity_factor  # dolint: disable=tracer-python-cast (static mesh-axis size)
             )
             out = experts_ep_a2a(
                 x.astype(self.dtype),

@@ -53,7 +53,7 @@ class ShortConvolution(nn.Module):
         def init(key, shape, dtype=jnp.float32):
             return jax.random.normal(key, shape, dtype) * self.std
 
-        weight = self.param("weight", nn.with_partitioning(init, (None, None)), (self.dim, self.width), jnp.float32)
+        weight = self.param("weight", nn.with_logical_partitioning(init, (None, None)), (self.dim, self.width), jnp.float32)
         return short_convolution(
             x, weight.astype(self.dtype), None, self.activation, conv_state
         )

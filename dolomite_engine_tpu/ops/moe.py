@@ -25,6 +25,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from ..utils.jax_compat import shard_map
+
 
 def route(
     router_logits: jax.Array, top_k: int
@@ -256,7 +258,7 @@ def experts_ep_a2a(
         top_k = selected_experts.shape[-1]
         assignments = tokens_local * top_k
         capacity = min(
-            assignments, max(1, int(capacity_factor * tokens_local * top_k / ep))
+            assignments, max(1, int(capacity_factor * tokens_local * top_k / ep))  # dolint: disable=tracer-python-cast (all static shapes/config)
         )
 
         flat_experts = selected_experts.reshape(-1)  # [A]
@@ -308,7 +310,7 @@ def experts_ep_a2a(
         return jnp.zeros_like(x).at[token_index].add(contrib)
 
     t_spec = P(token_axes, None)
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(

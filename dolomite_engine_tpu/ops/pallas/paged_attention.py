@@ -161,7 +161,7 @@ def paged_decode_attention(
         ],
     )
     kernel = functools.partial(
-        _paged_decode_kernel, softmax_scale=float(softmax_scale), page_size=page_size
+        _paged_decode_kernel, softmax_scale=float(softmax_scale), page_size=page_size  # dolint: disable=tracer-python-cast (static kernel param)
     )
     return pl.pallas_call(
         kernel,

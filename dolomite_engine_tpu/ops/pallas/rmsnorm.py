@@ -182,7 +182,7 @@ def fused_rmsnorm(
         f"weight {None if weight is None else weight.shape} must match hidden dim "
         f"{x.shape[-1:]}"
     )
-    eps = float(np.float32(eps))  # hashable static for custom_vjp nondiff
+    eps = float(np.float32(eps))  # hashable static for custom_vjp nondiff  # dolint: disable=tracer-python-cast,tracer-numpy-call
     if residual is None:
         return _fused_rmsnorm(x, weight, eps, interpret)
     assert residual.shape == x.shape and residual.dtype == x.dtype, (

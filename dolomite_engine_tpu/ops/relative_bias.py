@@ -75,7 +75,7 @@ class RelativePositionBias(nn.Module):
     def __call__(self, q_len: int, k_len: int, query_offset: jax.Array | int = 0) -> jax.Array:
         table = self.param(
             "embedding",
-            nn.with_partitioning(
+            nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=self.std), (None, "heads")
             ),
             (self.num_buckets, self.num_heads),

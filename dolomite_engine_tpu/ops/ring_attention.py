@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jax_compat import axis_size as _axis_size, shard_map
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -76,7 +78,7 @@ def ring_attention(
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
 
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_index = jax.lax.axis_index(axis_name)
     batch, s_loc, num_heads, dim = q.shape
     num_kv = k.shape[2]
@@ -210,4 +212,4 @@ def ring_attention_sharded(
             query_chunk_size=query_chunk_size,
         )
 
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec)(*operands)
+    return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec)(*operands)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..enums import AttentionImplementation
+from ..utils.jax_compat import axis_size, shard_map
 
 
 def ulysses_attention(
@@ -41,7 +42,7 @@ def ulysses_attention(
     [B, S_loc, Hkv_loc, D]; returns [B, S_loc, Hq_loc, D]. Requires sp | Hq_loc."""
     from .attention import attention as _attention
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     h_loc, kv_loc = q.shape[2], k.shape[2]
     if h_loc % sp != 0:
         raise ValueError(f"ulysses attention needs sp ({sp}) to divide the local query head count ({h_loc})")
@@ -118,4 +119,4 @@ def ulysses_attention_sharded(
             segment_ids_q=seg[0] if seg else None,
         )
 
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec)(*operands)
+    return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec)(*operands)

@@ -55,7 +55,7 @@ class PromptTuningCausalLM(nn.Module):
 
         prompt_embeddings = self.param(
             "prompt_embeddings",
-            nn.with_partitioning(init_fn, (None, "embed")),
+            nn.with_logical_partitioning(init_fn, (None, "embed")),
             (v, embed_dim),
             jnp.float32,
         )

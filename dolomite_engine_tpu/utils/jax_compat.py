@@ -1,0 +1,68 @@
+"""Version-bridging shims for renamed jax APIs.
+
+The codebase targets the current jax surface (`jax.shard_map`, `jax.set_mesh`), but the
+image pins jax 0.4.x where both still live under their pre-stabilization names
+(`jax.experimental.shard_map.shard_map`, the classic ``with mesh:`` resource env). Every
+call site goes through these shims so the skew lives in exactly one file and deletes
+cleanly when the pin moves past 0.5.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` where available, else the jax<0.5 experimental spelling.
+
+    ``check_vma`` (named ``check_rep`` pre-stabilization) defaults off: the legacy
+    checker rejects several legal collective bodies (ring ppermute accumulation loops)
+    that the stabilized API handles, and it exists only as a lint — numerics are
+    identical either way.
+    """
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    return _legacy_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
+def axis_size(axis_name: str):
+    """`jax.lax.axis_size` where available, else the classic `psum(1, axis)` spelling
+    (special-cased by jax to fold to the static mesh-axis size inside shard_map bodies)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pinned_host_supported() -> bool:
+    """Whether the backend exposes a ``pinned_host`` memory space (optimizer offload).
+
+    TPU always does; CPU only grew one after the 0.4.x line (older CPU backends expose
+    just ``unpinned_host``), so offload call sites and tests gate on this instead of
+    crashing inside NamedSharding construction.
+    """
+    try:
+        device = jax.devices()[0]
+        return any(m.kind == "pinned_host" for m in device.addressable_memories())
+    except Exception:
+        return False
+
+
+@contextlib.contextmanager
+def mesh_context(mesh):
+    """`jax.set_mesh` where available, else the classic ``with mesh:`` resource env.
+
+    Both make `mesh` ambient for jit/constraint resolution; `parallel/sharding.py`'s
+    `_ambient_mesh` reads whichever one is active.
+    """
+    set_mesh = getattr(jax, "set_mesh", None) or getattr(jax.sharding, "set_mesh", None)
+    cm = set_mesh(mesh) if set_mesh is not None else mesh
+    with cm:
+        yield mesh

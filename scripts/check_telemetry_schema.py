@@ -1,30 +1,14 @@
-"""Static telemetry-schema check: every sink call site must use a declared name.
+"""Static telemetry-schema check — thin shim over the dolo-lint telemetry checker.
 
     python scripts/check_telemetry_schema.py
 
-Walks every ``.py`` file under ``dolomite_engine_tpu/`` with ``ast`` (no execution of the
-scanned code) and validates each telemetry call site against the tables declared in
-``dolomite_engine_tpu/utils/telemetry.py``:
-
-- ``*.count("name", ...)``       -> name in ``KNOWN_COUNTERS``; with ``event=True`` the name
-  must also be in ``KNOWN_EVENTS`` (it writes an event record under that name)
-- ``*.event("name", ...)``       -> name in ``KNOWN_EVENTS``
-- ``*.gauge("name", ...)``       -> name in ``KNOWN_GAUGES`` (dynamic names — the
-  per-device memory fan-out — are exempt, same rule as counters)
-- ``*.emit_record("kind", ...)`` -> kind in ``RECORD_SCHEMA``; literal keyword fields must
-  cover the kind's required fields (calls forwarding ``**fields`` are kind-checked only)
-- ``{"kind": "x", ...}`` dict literals (the internal ``_emit`` payloads) -> kind declared in
-  ``RECORD_SCHEMA`` and literal keys covering its required fields
-
-Only calls whose receiver mentions ``telemetry`` (``telemetry.count``,
-``get_telemetry().count``, ``self.telemetry.event``) or ``self`` within the telemetry module
-itself are considered, so unrelated ``.count()``/``.get()`` methods don't false-positive.
-Dynamic (non-literal) names are skipped — the tables bound what *can* be written literally,
-which is every production call site today. Unused declared names are reported as errors too,
-so the table can't accrete dead entries.
-
-Run as a tier-1 test (tests/test_diagnostics.py) so a new record type or counter cannot
-ship without being declared here and documented in docs/OBSERVABILITY.md.
+The implementation moved to ``tools/lint/checkers/telemetry.py`` when the check became
+one rule family of the repo-wide static-analysis suite (``python -m tools.lint``); this
+entrypoint and its ``check_package()`` API are kept stable for existing callers and
+tests. Semantics are unchanged: every literal telemetry call site under
+``dolomite_engine_tpu/`` must use a name declared in ``utils/telemetry.py``'s tables,
+record literals must carry their kind's required fields, and every declared name must
+have a call site (no schema rot). See docs/STATIC_ANALYSIS.md for the rule catalog.
 """
 
 from __future__ import annotations
@@ -37,41 +21,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE_DIR = os.path.join(REPO_ROOT, "dolomite_engine_tpu")
 sys.path.insert(0, REPO_ROOT)
 
-# the modules allowed to call the registry through `self` / `self.telemetry`
-_SELF_CALL_FILES = ("telemetry.py", "diagnostics.py")
-
-
-def _is_telemetry_receiver(call: ast.Call, filename: str) -> bool:
-    receiver = call.func.value  # type: ignore[union-attr]
-    try:
-        text = ast.unparse(receiver)
-    except Exception:
-        return False
-    if "telemetry" in text.lower():
-        return True
-    return text == "self" and os.path.basename(filename) in _SELF_CALL_FILES
-
-
-def _literal_str(node: ast.AST) -> str | None:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
 
 def check_package(package_dir: str = PACKAGE_DIR) -> list[str]:
-    from dolomite_engine_tpu.utils.telemetry import (
-        KNOWN_COUNTERS,
-        KNOWN_EVENTS,
-        KNOWN_GAUGES,
-        RECORD_SCHEMA,
-    )
+    """Walk `package_dir` and return error strings (empty = clean). Same output format
+    as the original standalone checker."""
+    from tools.lint.checkers.telemetry import Usage, load_tables, reverse_errors, scan_tree
 
+    tables = load_tables()
     errors: list[str] = []
-    used_counters: set[str] = set()
-    used_events: set[str] = set()
-    used_gauges: set[str] = set()
-    used_kinds: set[str] = set()
-
+    usage = Usage()
     for dirpath, _dirnames, filenames in os.walk(package_dir):
         for filename in sorted(filenames):
             if not filename.endswith(".py"):
@@ -84,113 +42,10 @@ def check_package(package_dir: str = PACKAGE_DIR) -> list[str]:
                 except SyntaxError as error:
                     errors.append(f"{rel}: unparseable: {error}")
                     continue
-
-            for node in ast.walk(tree):
-                # {"kind": "x", ...} literals — the internal _emit payloads
-                if isinstance(node, ast.Dict):
-                    keys = [_literal_str(k) for k in node.keys if k is not None]
-                    if "kind" not in keys:
-                        continue
-                    kind = _literal_str(node.values[keys.index("kind")])
-                    if kind is None:
-                        continue
-                    used_kinds.add(kind)
-                    if kind not in RECORD_SCHEMA:
-                        errors.append(
-                            f"{rel}:{node.lineno}: record kind '{kind}' not declared in "
-                            "RECORD_SCHEMA"
-                        )
-                        continue
-                    literal_keys = {k for k in keys if k}
-                    missing = [
-                        f for f in RECORD_SCHEMA[kind] if f not in literal_keys
-                    ]
-                    # payloads assembled incrementally (record.update / **fields) only
-                    # carry some keys literally; require the declared fields only when the
-                    # literal looks complete (no dynamic construction around it is
-                    # detectable, so use: more literal keys than just "kind")
-                    if missing and len(literal_keys) > 1:
-                        errors.append(
-                            f"{rel}:{node.lineno}: record kind '{kind}' literal is missing "
-                            f"required field(s) {missing}"
-                        )
-                    continue
-
-                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
-                    continue
-                method = node.func.attr
-                if method not in ("count", "event", "gauge", "emit_record"):
-                    continue
-                if not _is_telemetry_receiver(node, path):
-                    continue
-                name = _literal_str(node.args[0]) if node.args else None
-                if name is None:
-                    continue  # dynamic name (e.g. count()'s internal event fan-out)
-
-                if method == "count":
-                    used_counters.add(name)
-                    if name not in KNOWN_COUNTERS:
-                        errors.append(
-                            f"{rel}:{node.lineno}: counter '{name}' not in KNOWN_COUNTERS"
-                        )
-                    wants_event = any(
-                        kw.arg == "event"
-                        and isinstance(kw.value, ast.Constant)
-                        and kw.value.value is True
-                        for kw in node.keywords
-                    )
-                    if wants_event:
-                        used_events.add(name)
-                        if name not in KNOWN_EVENTS:
-                            errors.append(
-                                f"{rel}:{node.lineno}: counter '{name}' emits an event "
-                                "(event=True) but is not in KNOWN_EVENTS"
-                            )
-                elif method == "event":
-                    used_events.add(name)
-                    if name not in KNOWN_EVENTS:
-                        errors.append(
-                            f"{rel}:{node.lineno}: event '{name}' not in KNOWN_EVENTS"
-                        )
-                elif method == "gauge":
-                    used_gauges.add(name)
-                    if name not in KNOWN_GAUGES:
-                        errors.append(
-                            f"{rel}:{node.lineno}: gauge '{name}' not in KNOWN_GAUGES"
-                        )
-                elif method == "emit_record":
-                    used_kinds.add(name)
-                    if name not in RECORD_SCHEMA:
-                        errors.append(
-                            f"{rel}:{node.lineno}: record kind '{name}' not declared in "
-                            "RECORD_SCHEMA"
-                        )
-                    elif not any(isinstance(a, ast.keyword) and a.arg is None for a in node.keywords):
-                        # no **fields forwarding: the literal keywords must cover the schema
-                        literal_kw = {kw.arg for kw in node.keywords if kw.arg} | {"step"}
-                        missing = [
-                            f for f in RECORD_SCHEMA[name] if f not in literal_kw
-                        ]
-                        if missing:
-                            errors.append(
-                                f"{rel}:{node.lineno}: emit_record('{name}') is missing "
-                                f"required field(s) {missing}"
-                            )
-
-    # reverse direction: a declared name nobody writes is dead weight / schema rot
-    for name in KNOWN_COUNTERS:
-        if name not in used_counters:
-            errors.append(f"KNOWN_COUNTERS entry '{name}' has no call site in the package")
-    for name in KNOWN_EVENTS:
-        if name not in used_events:
-            errors.append(f"KNOWN_EVENTS entry '{name}' has no call site in the package")
-    for name in KNOWN_GAUGES:
-        if name not in used_gauges:
-            errors.append(f"KNOWN_GAUGES entry '{name}' has no call site in the package")
-    for kind in RECORD_SCHEMA:
-        if kind not in used_kinds:
-            errors.append(f"RECORD_SCHEMA kind '{kind}' is never written in the package")
-
+            file_errors, file_usage = scan_tree(tree, path, tables)
+            errors.extend(f"{rel}:{line}: {msg}" for line, msg in file_errors)
+            usage.update(file_usage)
+    errors.extend(reverse_errors(tables, usage))
     return errors
 
 

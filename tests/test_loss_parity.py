@@ -15,6 +15,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACT = os.path.join(REPO, "LOSS_PARITY.json")
 
 
+import importlib.util
+
+import pytest
+
+
+@pytest.mark.skipif(
+    importlib.util.find_spec("dolomite_engine") is None,
+    reason="torch reference engine (dolomite_engine) not installed in this environment",
+)
 def test_live_loss_parity_short(tmp_path):
     """25 fresh steps through both engines: gap must stay under 1% (it is ~0: identical
     weights + data + fp32 semantics differ only by reduction order)."""

@@ -1,0 +1,43 @@
+"""dolo-lint: the repo's static-analysis suite (`python -m tools.lint`).
+
+Five repo-specific checkers over every ``.py`` file (AST-level, nothing scanned is
+executed): sharding/jit hygiene (the seed-failure class), tracer/recompile hazards,
+telemetry schema, the Pallas kernel-tier contract, and config/args drift. See
+docs/STATIC_ANALYSIS.md for the rule catalog and the suppression/baseline workflow.
+"""
+
+from __future__ import annotations
+
+from .checkers import all_checkers, all_rules
+from .framework import (
+    BASELINE_PATH,
+    REPO_ROOT,
+    Checker,
+    Finding,
+    LintResult,
+    SourceFile,
+    load_baseline,
+    run_checkers,
+    save_baseline,
+)
+
+
+def run_lint(rules: set[str] | None = None, baseline=None, files=None) -> LintResult:
+    """Run the full suite; the tier-1 test and the CLI both come through here."""
+    return run_checkers(all_checkers(), rules=rules, baseline=baseline, files=files)
+
+
+__all__ = [
+    "BASELINE_PATH",
+    "REPO_ROOT",
+    "Checker",
+    "Finding",
+    "LintResult",
+    "SourceFile",
+    "all_checkers",
+    "all_rules",
+    "load_baseline",
+    "run_checkers",
+    "run_lint",
+    "save_baseline",
+]

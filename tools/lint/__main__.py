@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.lint [--rule RULE ...] [--update-baseline] [--list-rules]``.
+
+Exit status 0 iff there are no non-baselined findings (and, under --update-baseline,
+after rewriting the baseline). Run from the repo root; it is what CI and the tier-1
+test gate on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from . import all_checkers, all_rules, run_lint, save_baseline
+from .framework import BASELINE_PATH
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m tools.lint", description=__doc__)
+    parser.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        help="only run these rule ids (repeatable); default: all rules",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help=f"rewrite {BASELINE_PATH} from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the committed baseline",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="list rule ids and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for checker in all_checkers():
+            for rule in checker.rules:
+                print(f"{rule}  (checker: {checker.name})")
+        return 0
+
+    rules = None
+    if args.rule:
+        known = set(all_rules())
+        unknown = [r for r in args.rule if r not in known]
+        if unknown:
+            print(f"unknown rule(s): {unknown}; see --list-rules", file=sys.stderr)
+            return 2
+        rules = set(args.rule)
+
+    t0 = time.monotonic()
+    from collections import Counter
+
+    result = run_lint(rules=rules, baseline=Counter() if args.no_baseline else None)
+    elapsed = time.monotonic() - t0
+
+    if args.update_baseline:
+        save_baseline(result.findings)
+        print(
+            f"dolo-lint: baseline rewritten with {len(result.findings)} finding(s) "
+            f"({result.files_scanned} files, {elapsed:.1f}s)"
+        )
+        return 0
+
+    for finding in result.new_findings:
+        print(finding.render(), file=sys.stderr)
+    if result.stale_baseline:
+        print(
+            f"dolo-lint: note: {len(result.stale_baseline)} stale baseline entr"
+            f"{'ies' if len(result.stale_baseline) > 1 else 'y'} (fixed findings); "
+            "run --update-baseline to shrink the baseline",
+            file=sys.stderr,
+        )
+    status = "FAILED" if result.new_findings else "OK"
+    baselined = len(result.findings) - len(result.new_findings)
+    print(
+        f"dolo-lint {status}: {len(result.new_findings)} new finding(s), "
+        f"{baselined} baselined, {result.files_scanned} files in {elapsed:.1f}s"
+    )
+    return 1 if result.new_findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,32 @@
+"""dolo-lint checker registry.
+
+Each module contributes one Checker subclass; `all_checkers()` instantiates the suite in
+a stable order. To add a checker: subclass `tools.lint.framework.Checker`, list its rule
+ids in `rules`, implement `visit_file`/`finalize`, register it here, and document the
+rules in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from .config_drift import ConfigDriftChecker
+from .kernels import KernelContractChecker
+from .sharding import ShardingChecker
+from .telemetry import TelemetryChecker
+from .tracer import TracerChecker
+
+
+def all_checkers():
+    return [
+        ShardingChecker(),
+        TracerChecker(),
+        TelemetryChecker(),
+        KernelContractChecker(),
+        ConfigDriftChecker(),
+    ]
+
+
+def all_rules():
+    rules: list[str] = []
+    for checker in all_checkers():
+        rules.extend(checker.rules)
+    return rules

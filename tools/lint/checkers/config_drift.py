@@ -1,0 +1,190 @@
+"""Config/args drift: example YAMLs vs the arguments.py dataclasses, and dead arg fields.
+
+Two directions:
+
+- ``config-unknown-field``: every YAML under ``configs/`` must statically validate
+  against its mode's args tree (same root-class heuristic as
+  tests/test_example_configs.py) — keys are checked recursively against pydantic
+  ``model_fields`` WITHOUT instantiating the models, so no validator/`model_post_init`
+  code runs and a half-broken example still gets all its keys reported.
+- ``config-dead-field``: every field declared on a ``BaseArgs`` subclass in
+  arguments.py must be read somewhere in the package/tools/scripts (attribute access,
+  keyword arg, or literal-string lookup) outside arguments.py itself. Intentional
+  compat no-op fields (accepted-and-ignored reference knobs) carry an inline
+  ``# dolint: disable=config-dead-field`` with the rationale, which doubles as their
+  documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import typing
+
+from ..framework import Checker, Finding, SourceFile
+
+_ARGS_REL = "dolomite_engine_tpu/arguments.py"
+
+# keys consumed by `model_validator(mode="before")` hooks rather than declared fields;
+# static model_fields inspection cannot see these remappings
+_BEFORE_VALIDATOR_ALIASES = {"LRSchedulerArgs": {"lr_schedule"}}
+
+
+def _config_root_class(filename: str, arguments_module) -> type:
+    name = os.path.basename(filename)
+    if "unshard" in name:
+        return arguments_module.UnshardingArgs
+    if "generation" in name:
+        return arguments_module.InferenceArgs
+    return arguments_module.TrainingArgs
+
+
+def _base_args_models(annotation) -> list[type]:
+    """BaseArgs subclasses reachable from a field annotation (unwraps Optional/Union/list)."""
+    from dolomite_engine_tpu.utils.pydantic import BaseArgs
+
+    out: list[type] = []
+    stack = [annotation]
+    while stack:
+        ann = stack.pop()
+        try:
+            if isinstance(ann, type) and issubclass(ann, BaseArgs):
+                out.append(ann)
+                continue
+        except TypeError:  # typing constructs that masquerade as types
+            pass
+        stack.extend(typing.get_args(ann))
+    return out
+
+
+def _key_line(lines: list[str], key: str) -> int:
+    for i, line in enumerate(lines, 1):
+        if line.lstrip().startswith(f"{key}:"):
+            return i
+    return 1
+
+
+class ConfigDriftChecker(Checker):
+    name = "config"
+    rules = ("config-unknown-field", "config-dead-field")
+
+    def __init__(self):
+        self._referenced: set[str] = set()
+        self._fields: list[tuple[str, str, int]] = []  # (class, field, line in arguments.py)
+
+    def start(self, repo_root: str) -> None:
+        self._repo_root = repo_root
+        self._referenced = set()
+        self._fields = []
+        with open(os.path.join(repo_root, _ARGS_REL), encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(isinstance(b, ast.Name) and b.id == "BaseArgs" for b in node.bases):
+                continue
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name):
+                    self._fields.append((node.name, item.target.id, item.lineno))
+
+    def _collect_refs(self, root: ast.AST, skip_validators: bool) -> None:
+        """Record field-name references under `root`; with `skip_validators`, subtrees of
+        `model_post_init` / `@model_validator` functions are ignored (a field that is only
+        validated, coerced, or warned about is still dead)."""
+        if skip_validators and isinstance(root, ast.FunctionDef):
+            if root.name == "model_post_init" or any(
+                "model_validator" in ast.unparse(d) for d in root.decorator_list
+            ):
+                return
+        for node in ast.iter_child_nodes(root):
+            if isinstance(node, ast.Attribute):
+                self._referenced.add(node.attr)
+            elif isinstance(node, ast.keyword) and node.arg:
+                self._referenced.add(node.arg)
+            elif isinstance(node, ast.Subscript):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    self._referenced.add(sl.value)
+            elif isinstance(node, ast.Call):
+                name = node.func.attr if isinstance(node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name) else None
+                )
+                if name in ("getattr", "get", "pop") and node.args:
+                    first = node.args[1] if name == "getattr" and len(node.args) > 1 else node.args[0]
+                    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                        self._referenced.add(first.value)
+            self._collect_refs(node, skip_validators)
+
+    def visit_file(self, f: SourceFile) -> list[Finding]:
+        self._collect_refs(f.tree, skip_validators=f.rel == _ARGS_REL)
+        return []
+
+    # ------------------------------------------------------------------ finalize
+    def _walk_yaml(self, model_cls, data: dict, lines, rel, prefix, findings) -> None:
+        fields = model_cls.model_fields
+        aliases = _BEFORE_VALIDATOR_ALIASES.get(model_cls.__name__, set())
+        for key, value in data.items():
+            if key not in fields:
+                if key in aliases:
+                    continue
+                dotted = f"{prefix}{key}"
+                findings.append(
+                    Finding(
+                        "config-unknown-field",
+                        rel,
+                        _key_line(lines, key),
+                        f"'{dotted}' is not a field of {model_cls.__name__}",
+                    )
+                )
+                continue
+            models = _base_args_models(fields[key].annotation)
+            if not models:
+                continue
+            if isinstance(value, dict):
+                self._walk_yaml(models[0], value, lines, rel, f"{prefix}{key}.", findings)
+            elif isinstance(value, list):
+                for item in value:
+                    if isinstance(item, dict):
+                        self._walk_yaml(
+                            models[0], item, lines, rel, f"{prefix}{key}[].", findings
+                        )
+
+    def finalize(self) -> list[Finding]:
+        findings: list[Finding] = []
+
+        import yaml
+
+        import dolomite_engine_tpu.arguments as arguments_module
+
+        for path in sorted(
+            glob.glob(os.path.join(self._repo_root, "configs", "**", "*.yml"), recursive=True)
+        ):
+            rel = os.path.relpath(path, self._repo_root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            data = yaml.safe_load(text)
+            if not isinstance(data, dict):
+                continue
+            self._walk_yaml(
+                _config_root_class(path, arguments_module),
+                data,
+                text.splitlines(),
+                rel,
+                "",
+                findings,
+            )
+
+        for class_name, field_name, line in self._fields:
+            if field_name not in self._referenced:
+                findings.append(
+                    Finding(
+                        "config-dead-field",
+                        _ARGS_REL,
+                        line,
+                        f"{class_name}.{field_name} is never read outside arguments.py "
+                        "(dead arg field — delete it, or mark an intentional compat no-op "
+                        "with an inline suppression)",
+                    )
+                )
+        return findings

@@ -1,0 +1,174 @@
+"""Kernel-tier contract: every Pallas kernel family stays wired end to end.
+
+`ops/pallas/config.py` declares the families (`KERNEL_FAMILIES`); the contract
+(docs/PERFORMANCE.md "Kernel tier") is that each family
+
+1. is selectable from YAML — `arguments.py::KernelArgs` carries a field per family
+   (``kernel-family-config-drift``, checked both directions, plus KernelConfig's own
+   dataclass fields);
+2. has an XLA reference dispatch site — some package call gates on
+   ``use_pallas("<family>")`` so the plain-XLA lowering stays the default and the
+   numerical reference (``kernel-family-no-dispatch-gate``);
+3. has an interpret-mode parity test in tests/ops/test_pallas_kernels.py so CPU tier-1
+   exercises the kernel against its reference (``kernel-family-no-parity-test``).
+
+Also flags ``use_pallas``/``kernel_overrides`` calls naming unknown families
+(``kernel-unknown-family``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..framework import Checker, Finding, SourceFile
+
+_CONFIG_REL = "dolomite_engine_tpu/ops/pallas/config.py"
+_ARGS_REL = "dolomite_engine_tpu/arguments.py"
+_PARITY_TEST_REL = "tests/ops/test_pallas_kernels.py"
+
+
+def _tuple_of_strings(node: ast.AST) -> set[str] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+        return out
+    return None
+
+
+def _class_field_names(tree: ast.AST, class_name: str) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {
+                item.target.id
+                for item in node.body
+                if isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name)
+            }
+    return set()
+
+
+class KernelContractChecker(Checker):
+    name = "kernels"
+    rules = (
+        "kernel-family-config-drift",
+        "kernel-family-no-dispatch-gate",
+        "kernel-family-no-parity-test",
+        "kernel-unknown-family",
+    )
+
+    def __init__(self):
+        self._families: set[str] = set()
+        self._config_fields: set[str] = set()
+        self._args_fields: set[str] = set()
+        self._parity_source: str = ""
+        self._gated: set[str] = set()
+
+    def start(self, repo_root: str) -> None:
+        self._gated = set()
+        with open(os.path.join(repo_root, _CONFIG_REL), encoding="utf-8") as f:
+            config_tree = ast.parse(f.read())
+        for node in ast.walk(config_tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KERNEL_FAMILIES" for t in node.targets
+            ):
+                self._families = _tuple_of_strings(node.value) or set()
+        self._config_fields = _class_field_names(config_tree, "KernelConfig")
+
+        with open(os.path.join(repo_root, _ARGS_REL), encoding="utf-8") as f:
+            self._args_fields = _class_field_names(ast.parse(f.read()), "KernelArgs")
+
+        parity_path = os.path.join(repo_root, _PARITY_TEST_REL)
+        self._parity_source = (
+            open(parity_path, encoding="utf-8").read() if os.path.isfile(parity_path) else ""
+        )
+
+    def visit_file(self, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        if not f.rel.startswith("dolomite_engine_tpu/"):
+            return findings
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if name in ("use_pallas", "kernel_backend") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    if arg.value in self._families:
+                        self._gated.add(arg.value)
+                    else:
+                        findings.append(
+                            Finding(
+                                "kernel-unknown-family",
+                                f.rel,
+                                node.lineno,
+                                f"{name}('{arg.value}'): not a KERNEL_FAMILIES entry "
+                                f"({sorted(self._families)})",
+                            )
+                        )
+        return findings
+
+    def finalize(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for missing in sorted(self._families - self._config_fields):
+            findings.append(
+                Finding(
+                    "kernel-family-config-drift",
+                    _CONFIG_REL,
+                    1,
+                    f"family '{missing}' is in KERNEL_FAMILIES but not a KernelConfig field",
+                )
+            )
+        for extra in sorted(self._config_fields - self._families):
+            findings.append(
+                Finding(
+                    "kernel-family-config-drift",
+                    _CONFIG_REL,
+                    1,
+                    f"KernelConfig field '{extra}' is not in KERNEL_FAMILIES",
+                )
+            )
+        for missing in sorted(self._families - self._args_fields):
+            findings.append(
+                Finding(
+                    "kernel-family-config-drift",
+                    _ARGS_REL,
+                    1,
+                    f"family '{missing}' has no KernelArgs field (not selectable from YAML)",
+                )
+            )
+        for extra in sorted(self._args_fields - self._families):
+            findings.append(
+                Finding(
+                    "kernel-family-config-drift",
+                    _ARGS_REL,
+                    1,
+                    f"KernelArgs field '{extra}' names no kernel family",
+                )
+            )
+        for family in sorted(self._families - self._gated):
+            findings.append(
+                Finding(
+                    "kernel-family-no-dispatch-gate",
+                    _CONFIG_REL,
+                    1,
+                    f"family '{family}' has no use_pallas('{family}') dispatch gate in the "
+                    "package — the XLA reference path is unreachable",
+                )
+            )
+        for family in sorted(self._families):
+            if family not in self._parity_source:
+                findings.append(
+                    Finding(
+                        "kernel-family-no-parity-test",
+                        _PARITY_TEST_REL,
+                        1,
+                        f"family '{family}' never appears in the interpret-mode parity "
+                        "tests",
+                    )
+                )
+        return findings

@@ -1,0 +1,211 @@
+"""Sharding / jit hygiene — the checker that mechanically catches the seed failure class.
+
+The repo's sharding contract (parallel/sharding.py): model code names axes LOGICALLY
+("vocab", "embed", "act_batch", ...) and every logical name reaches a mesh axis through
+exactly one of the two translators — `logical_to_mesh_sharding` for param/state trees,
+`parallel.sharding.logical_constraint` for activations (which resolves the ambient
+`nn.logical_axis_rules` scope installed by `ModelWrapper.apply_scope`). A logical name
+written directly into a mesh-axis position (`PartitionSpec`, `NamedSharding`,
+`nn.with_partitioning` boxes) bypasses translation, and jit then rejects it —
+``ValueError: Resource axis: vocab ... is not found in mesh`` — which is precisely the
+defect that broke 46 seed tier-1 tests.
+
+Rules:
+- ``sharding-logical-axis-in-mesh-spec``: a logical axis name appears as a literal in a
+  mesh-axis position (PartitionSpec/NamedSharding/named_sharding args).
+- ``sharding-undeclared-mesh-axis``: a mesh-axis literal that is neither a declared mesh
+  axis (parallel/mesh.py MESH_AXES) nor a logical name (the rule above owns those).
+- ``sharding-raw-partitioning-box``: `nn.with_partitioning` in package code. Raw
+  `Partitioned` boxes apply their names as mesh axes whenever a mesh env is ambient
+  (flax `Partitioned.unbox`), which is the leak mechanism; params must use
+  `nn.with_logical_partitioning`, whose unboxing resolves the ambient rules scope.
+- ``sharding-flax-logical-constraint``: direct `nn.with_logical_constraint` call. Flax's
+  version silently no-ops under the classic ``with mesh:`` resource env (its mesh probe
+  only sees `jax.set_mesh`); `parallel.sharding.logical_constraint` handles both.
+- ``sharding-unknown-logical-axis``: a literal axis name passed to `logical_constraint`
+  or `nn.with_logical_partitioning` that no logical-axis rule declares — it would be
+  silently unconstrained (typo guard).
+
+Both vocabularies are parsed from their single sources of truth
+(`parallel/sharding.py::get_logical_axis_rules`, `parallel/mesh.py::MESH_AXES`) so the
+checker can never drift from the code it polices.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..framework import Checker, Finding, SourceFile
+
+# mesh-spec constructors whose positional string args are mesh-axis names
+_SPEC_CALLS = {"PartitionSpec", "NamedSharding", "named_sharding", "P"}
+# call sites whose string args are LOGICAL axis names
+_LOGICAL_CALLS = {"logical_constraint", "with_logical_partitioning", "with_logical_constraint"}
+
+
+def _last_segment(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _axis_literals(nodes: list[ast.AST]):
+    """Yield (axis-name constant, node) from spec-position args: strings and tuples/lists
+    of strings; everything else (None, *args, variables) is ignored."""
+    for node in nodes:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    yield elt.value, elt
+
+
+def parse_logical_axes(sharding_py_source: str) -> set[str]:
+    """Logical axis vocabulary: first elements of the rule tuples in
+    get_logical_axis_rules' `rules` list literal."""
+    tree = ast.parse(sharding_py_source)
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "get_logical_axis_rules":
+            for stmt in ast.walk(node):
+                if not (isinstance(stmt, (ast.Assign, ast.AnnAssign))):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                if not any(isinstance(t, ast.Name) and t.id == "rules" for t in targets):
+                    continue
+                value = stmt.value
+                if not isinstance(value, ast.List):
+                    continue
+                for elt in value.elts:
+                    if (
+                        isinstance(elt, ast.Tuple)
+                        and elt.elts
+                        and isinstance(elt.elts[0], ast.Constant)
+                        and isinstance(elt.elts[0].value, str)
+                    ):
+                        names.add(elt.elts[0].value)
+    return names
+
+
+def parse_mesh_axes(mesh_py_source: str) -> set[str]:
+    tree = ast.parse(mesh_py_source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "MESH_AXES" for t in node.targets
+        ):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return {
+                    elt.value
+                    for elt in node.value.elts
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+                }
+    return set()
+
+
+class ShardingChecker(Checker):
+    name = "sharding"
+    rules = (
+        "sharding-logical-axis-in-mesh-spec",
+        "sharding-undeclared-mesh-axis",
+        "sharding-raw-partitioning-box",
+        "sharding-flax-logical-constraint",
+        "sharding-unknown-logical-axis",
+    )
+
+    def __init__(self, logical_axes: set[str] | None = None, mesh_axes: set[str] | None = None):
+        self._logical_axes = logical_axes
+        self._mesh_axes = mesh_axes
+
+    def start(self, repo_root: str) -> None:
+        package = os.path.join(repo_root, "dolomite_engine_tpu")
+        if self._logical_axes is None:
+            with open(os.path.join(package, "parallel", "sharding.py"), encoding="utf-8") as f:
+                self._logical_axes = parse_logical_axes(f.read())
+        if self._mesh_axes is None:
+            with open(os.path.join(package, "parallel", "mesh.py"), encoding="utf-8") as f:
+                self._mesh_axes = parse_mesh_axes(f.read())
+
+    def visit_file(self, f: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        logical, mesh = self._logical_axes or set(), self._mesh_axes or set()
+        in_package = f.rel.startswith("dolomite_engine_tpu/")
+        # the translator itself assembles specs from already-resolved entries
+        if f.rel == "dolomite_engine_tpu/parallel/sharding.py":
+            return findings
+
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_segment(node.func)
+            if name is None:
+                continue
+
+            if name in _SPEC_CALLS:
+                args = node.args
+                if name == "NamedSharding" and args:  # first arg is the mesh object
+                    args = args[1:]
+                for axis, where in _axis_literals(args):
+                    if axis in logical:
+                        findings.append(
+                            Finding(
+                                "sharding-logical-axis-in-mesh-spec",
+                                f.rel,
+                                where.lineno,
+                                f"logical axis '{axis}' used as a mesh axis in {name}(...); "
+                                "translate through logical_to_mesh_sharding / "
+                                "logical_constraint instead",
+                            )
+                        )
+                    elif axis not in mesh:
+                        findings.append(
+                            Finding(
+                                "sharding-undeclared-mesh-axis",
+                                f.rel,
+                                where.lineno,
+                                f"axis '{axis}' in {name}(...) is not declared in "
+                                "parallel/mesh.py MESH_AXES",
+                            )
+                        )
+
+            elif name == "with_partitioning" and in_package:
+                findings.append(
+                    Finding(
+                        "sharding-raw-partitioning-box",
+                        f.rel,
+                        node.lineno,
+                        "nn.with_partitioning applies its names as RAW mesh axes whenever "
+                        "a mesh env is ambient; use nn.with_logical_partitioning",
+                    )
+                )
+
+            elif name == "with_logical_constraint" and in_package:
+                findings.append(
+                    Finding(
+                        "sharding-flax-logical-constraint",
+                        f.rel,
+                        node.lineno,
+                        "flax's with_logical_constraint no-ops under the classic mesh "
+                        "resource env; use parallel.sharding.logical_constraint",
+                    )
+                )
+
+            if name in _LOGICAL_CALLS and in_package:
+                # axis args: logical_constraint(x, axes) / with_logical_partitioning(fn, names)
+                axis_args = node.args[1:2]
+                for axis, where in _axis_literals(axis_args):
+                    if axis not in logical:
+                        findings.append(
+                            Finding(
+                                "sharding-unknown-logical-axis",
+                                f.rel,
+                                where.lineno,
+                                f"'{axis}' is not a declared logical axis "
+                                "(parallel/sharding.py get_logical_axis_rules); the "
+                                "constraint would silently not bind",
+                            )
+                        )
+        return findings

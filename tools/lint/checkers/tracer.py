@@ -1,0 +1,160 @@
+"""Recompile / tracer hazards inside code that runs under jit.
+
+Host-python escapes on traced values either crash at trace time
+(``ConcretizationTypeError`` from ``bool()``/``int()``/``float()``), force a device sync
+(``.item()``), or silently constant-fold per-trace and recompile on every new value
+(``np.*`` math on traced arrays falls back to host numpy via ``__array__``). All three
+belong outside the jitted region.
+
+Because plenty of HOST-side numpy in this repo is legitimate (packing preprocessing,
+alibi/rope static tables), the checker only looks inside contexts that actually trace:
+
+- ``models/``: bodies of ``__call__``/``setup`` methods (the flax forward path) and
+  functions nested in them;
+- ``ops/``: functions with a ``jax.Array``-annotated parameter (the repo's convention
+  for traced signatures) and their nested functions;
+- ``serving/`` + ``generation_utils.py``: functions that the file itself passes to
+  ``jax.jit`` (resolved through one level of local aliasing) and their nested functions.
+
+Rules: ``tracer-host-item`` (.item()), ``tracer-python-cast`` (bool/int/float on a
+non-literal), ``tracer-numpy-call`` (np./numpy. calls). Static trace-time uses that are
+genuinely fine carry an inline ``# dolint: disable=...`` with the rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..framework import Checker, Finding, SourceFile
+
+_CASTS = {"bool", "int", "float"}
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+
+
+def _attr_root(node: ast.AST) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_traced_ops_fn(fn: ast.FunctionDef) -> bool:
+    args = fn.args
+    all_args = args.posonlyargs + args.args + args.kwonlyargs
+    for a in all_args:
+        if a.annotation is not None and "jax.Array" in ast.unparse(a.annotation):
+            return True
+    return False
+
+
+def _jitted_fn_names(tree: ast.AST) -> set[str]:
+    """Names of functions this file passes to jax.jit, through one aliasing level
+    (``decode_impl = self._decode_impl ...; jax.jit(decode_impl)``)."""
+    aliases: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                refs = set()
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Attribute):
+                        refs.add(sub.attr)
+                    elif isinstance(sub, ast.Name):
+                        refs.add(sub.id)
+                aliases.setdefault(target.id, set()).update(refs)
+
+    jitted: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and ast.unparse(node.func).endswith("jax.jit")):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name):
+            jitted.add(arg.id)
+            jitted.update(aliases.get(arg.id, ()))
+        elif isinstance(arg, ast.Attribute):
+            jitted.add(arg.attr)
+    return jitted
+
+
+class TracerChecker(Checker):
+    name = "tracer"
+    rules = ("tracer-host-item", "tracer-python-cast", "tracer-numpy-call")
+
+    def visit_file(self, f: SourceFile) -> list[Finding]:
+        rel = f.rel
+        in_models = rel.startswith("dolomite_engine_tpu/models/")
+        in_ops = rel.startswith("dolomite_engine_tpu/ops/")
+        in_serving = rel.startswith("dolomite_engine_tpu/serving/") or rel.endswith(
+            "generation_utils.py"
+        )
+        if not (in_models or in_ops or in_serving):
+            return []
+
+        traced_bodies: list[ast.FunctionDef] = []
+        if in_models:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) and item.name in (
+                            "__call__",
+                            "setup",
+                        ):
+                            traced_bodies.append(item)
+        if in_ops:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.FunctionDef) and _is_traced_ops_fn(node):
+                    traced_bodies.append(node)
+        if in_serving:
+            jitted = _jitted_fn_names(f.tree)
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.FunctionDef) and node.name in jitted:
+                    traced_bodies.append(node)
+
+        findings: list[Finding] = []
+        seen: set[int] = set()  # nested functions appear under their parent too
+        for body in traced_bodies:
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                func = node.func
+
+                if isinstance(func, ast.Attribute) and func.attr == "item" and not node.args:
+                    findings.append(
+                        Finding(
+                            "tracer-host-item",
+                            rel,
+                            node.lineno,
+                            ".item() forces a device sync / fails under trace; keep host "
+                            "readbacks outside the jitted region",
+                        )
+                    )
+                elif (
+                    isinstance(func, ast.Name)
+                    and func.id in _CASTS
+                    and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    findings.append(
+                        Finding(
+                            "tracer-python-cast",
+                            rel,
+                            node.lineno,
+                            f"{func.id}() on a non-literal inside a traced body raises "
+                            "ConcretizationTypeError on traced values (or silently bakes "
+                            "a static); compute with jnp or hoist out of the trace",
+                        )
+                    )
+                elif isinstance(func, ast.Attribute) and _attr_root(func) in _NUMPY_ROOTS:
+                    findings.append(
+                        Finding(
+                            "tracer-numpy-call",
+                            rel,
+                            node.lineno,
+                            f"{ast.unparse(func)}(...) inside a traced body falls back to "
+                            "host numpy (per-trace constant folding / recompiles); use jnp "
+                            "or hoist the static precompute",
+                        )
+                    )
+        return findings
