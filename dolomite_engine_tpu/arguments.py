@@ -735,6 +735,17 @@ class GenerationParameters(BaseArgs):
     # draft tokens proposed per engine step (K >= 1); the jitted verify step scores K+1
     # positions per slot and compiles once
     draft_k: int = 4
+    # ---- distributed serving (serving/cluster/, docs/SERVING.md) ----
+    # tensor-parallel size per engine replica: the engine's jitted prefill/decode/verify
+    # programs run over a TP mesh with params and KV heads sharded (must divide the
+    # visible device count; 1 = single-device engine)
+    tensor_parallel_size: int = 1
+    # engine replicas behind the telemetry-driven router (serving/cluster/router.py):
+    # each owns its own KV pool and queue; prefix-affinity + least-loaded routing
+    replicas: int = 1
+    # prefill/decode disaggregation (serving/cluster/disagg.py): each replica becomes a
+    # prefill worker feeding a decode worker through an explicit KV page handoff
+    disaggregate: bool = False
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(
@@ -765,6 +776,21 @@ class GenerationParameters(BaseArgs):
             raise ValueError(
                 "speculate_ngram and draft_model are mutually exclusive draft sources"
             )
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.tensor_parallel_size < 1:
+            raise ValueError(
+                f"tensor_parallel_size must be >= 1, got {self.tensor_parallel_size}"
+            )
+        if self.tensor_parallel_size > 1:
+            import jax  # deferred: only sharded configs pay for backend discovery
+
+            device_count = jax.device_count()
+            if device_count % self.tensor_parallel_size != 0:
+                raise ValueError(
+                    f"tensor_parallel_size={self.tensor_parallel_size} does not divide "
+                    f"the visible device count ({device_count})"
+                )
 
 
 class InferenceArgs(BaseArgs):

@@ -51,6 +51,9 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
         "speculate_ngram",
         "draft_model",
         "draft_k",
+        "tensor_parallel_size",
+        "replicas",
+        "disaggregate",
     ):
         generate_kwargs.pop(key, None)
 
@@ -136,25 +139,54 @@ def _generate_with_engine(
         (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
     )
     draft_model, draft_params = load_draft_model(gp.draft_model)
-    engine = ServingEngine(
-        model.model,
-        params,
-        num_slots=gp.batch_size,
-        max_len=max_len,
-        prefill_bucket_multiple=multiple,
-        max_waiting=max(2 * gp.batch_size, 8),
-        eos_token_id=model.eos_token_id,
-        pad_token_id=pad_token_id,
-        paged=gp.paged_kv_cache,
-        page_size=gp.kv_page_size,
-        num_pages=gp.kv_num_pages,
-        prefill_chunk_tokens=gp.prefill_chunk_tokens,
-        prefix_caching=gp.prefix_caching,
-        speculate_ngram=gp.speculate_ngram,
-        draft_model=draft_model,
-        draft_params=draft_params,
-        draft_k=gp.draft_k,
-    )
+
+    # distributed tier (serving/cluster/): TP-sharded jits, prefill/decode
+    # disaggregation, and a router over N replicas — all optional, default 1/1/off
+    mesh = rules = None
+    if gp.tensor_parallel_size > 1:
+        mesh = MeshManager.get_mesh()  # main() built it with the requested tp size
+        rules = model.sharding_rules()
+
+    def build_engine(**overrides):
+        kwargs = dict(
+            num_slots=gp.batch_size,
+            max_len=max_len,
+            prefill_bucket_multiple=multiple,
+            max_waiting=max(2 * gp.batch_size, 8),
+            eos_token_id=model.eos_token_id,
+            pad_token_id=pad_token_id,
+            paged=gp.paged_kv_cache,
+            page_size=gp.kv_page_size,
+            num_pages=gp.kv_num_pages,
+            prefill_chunk_tokens=gp.prefill_chunk_tokens,
+            prefix_caching=gp.prefix_caching,
+            speculate_ngram=gp.speculate_ngram,
+            draft_model=draft_model,
+            draft_params=draft_params,
+            draft_k=gp.draft_k,
+            mesh=mesh,
+            sharding_rules=rules,
+        )
+        kwargs.update(overrides)
+        return ServingEngine(model.model, params, **kwargs)
+
+    router = None
+    if gp.replicas > 1 or gp.disaggregate:
+        from .serving.cluster import DisaggregatedEngine, EngineReplica, Router, route_batch
+
+        replicas = []
+        for replica_id in range(gp.replicas):
+            if gp.disaggregate:
+                prefill = build_engine(
+                    prefill_only=True, speculate_ngram=False, draft_model=None, draft_params=None
+                )
+                replica_engine = DisaggregatedEngine(prefill, [build_engine()])
+            else:
+                replica_engine = build_engine()
+            replicas.append(EngineReplica(replica_id, replica_engine))
+        router = Router(replicas)
+    else:
+        engine = build_engine()
 
     for dataset in datasets_list:
         specs = []
@@ -169,7 +201,10 @@ def _generate_with_engine(
                     on_finish=lambda state: progress_bar.update(1),
                 )
             )
-        states = serve_batch(engine, specs)
+        if router is not None:
+            states = route_batch(router, specs)
+        else:
+            states = serve_batch(engine, specs)
 
         output_path = os.path.join(args.output_dir, f"output-{dataset.data_name}.jsonl")
         with open(output_path, "w") as output_file:
@@ -186,6 +221,16 @@ def _generate_with_engine(
                     + "\n"
                 )
         log_rank_0(20, f"wrote {output_path}")
+
+    if router is not None:
+        hit_rate = router.stats.affinity_hit_rate()
+        log_rank_0(
+            20,
+            f"router: {router.stats.routed} routed / {router.stats.rejected} rejected "
+            f"over {len(router.replicas)} replica(s) "
+            f"{dict(sorted(router.stats.per_replica_routed.items()))}, prefix-affinity "
+            f"hit rate {'n/a' if hit_rate is None else f'{hit_rate:.1%}'}",
+        )
 
 
 def load_draft_model(name: str | None) -> tuple:
@@ -235,7 +280,9 @@ def main(args: InferenceArgs | None = None) -> None:
     args.kernel_args.install()
 
     if not MeshManager.is_initialized():
-        MeshManager()
+        # tp > 1: params load sharded over the tp axis and the serving engine's jits
+        # run over the same mesh (serving/cluster/, docs/SERVING.md)
+        MeshManager(tensor_parallel_size=args.generation_parameters.tensor_parallel_size)
 
     if args.load_args is None:
         model = ModelWrapperForFinetuning(

@@ -89,6 +89,8 @@ def paged_scatter_kv(
     ``write_valid == False`` (right-pad tail of a prefill chunk) land at flat index 0,
     where collisions are harmless because trash content is never attended unmasked.
     """
+    from ..parallel.sharding import logical_constraint
+
     num_pages, page_size = pages.shape[:2]
     batch, seq = positions.shape
     page_ids = jnp.take_along_axis(page_table, positions // page_size, axis=1)  # [B, S]
@@ -99,7 +101,13 @@ def paged_scatter_kv(
     flat_pages = flat_pages.at[flat_index.reshape(-1)].set(
         new.reshape((batch * seq,) + new.shape[2:])
     )
-    return flat_pages.reshape(pages.shape)
+    # keep the pool kv-head-sharded through the scatter (serving/kv_cache.shard_kv_caches
+    # places it that way): without the pin GSPMD may emit a replicated output, which both
+    # materializes the whole pool per device and flips the donated decode-step input
+    # sharding on the next call (a recompile, breaking decode_compiles == 1)
+    return logical_constraint(
+        flat_pages.reshape(pages.shape), (None, None, "act_kv_heads", None)
+    )
 
 
 def paged_gather_kv(pages: jax.Array, page_table: jax.Array) -> jax.Array:
@@ -109,13 +117,20 @@ def paged_gather_kv(pages: jax.Array, page_table: jax.Array) -> jax.Array:
     K/V, trash) — finite garbage the attention mask reduces to exactly-zero probability,
     so downstream attention is bitwise identical to a dense cache with the same frontier.
     """
+    from ..parallel.sharding import logical_constraint
+
     num_pages, page_size = pages.shape[:2]
     batch, max_pages = page_table.shape
     flat_pages = pages.reshape((num_pages * page_size,) + pages.shape[2:])
     index = (
         page_table[:, :, None] * page_size + jnp.arange(page_size, dtype=page_table.dtype)
     ).reshape(batch, max_pages * page_size)
-    return flat_pages[index]
+    # the gathered per-row view feeds attention with kv heads tp-sharded (the gather
+    # indexes only the unsharded pages dim, so each device gathers its local head
+    # shard). The slot-batch dim stays unconstrained: batch parallelism in the serving
+    # tier is done with whole replicas (serving/cluster/router.py), and pinning it to
+    # the data axes would force a reshard on meshes where fsdp > num_slots.
+    return logical_constraint(flat_pages[index], (None, None, "act_kv_heads", None))
 
 
 def _repeat_kv(k: jax.Array, num_query_heads: int) -> jax.Array:

@@ -108,7 +108,10 @@ def logical_constraint(x, axes):
     Resolution follows flax: first matching rule wins; names without a rule (or mapping to
     None) leave the dimension unconstrained-as-replicated; axes absent from the mesh are
     dropped (size-1 axes are always present on MeshManager's 5-axis mesh, so this only
-    triggers on hand-built test meshes).
+    triggers on hand-built test meshes). Axes that don't divide their dimension evenly
+    are dropped too — the activation-side mirror of `prune_indivisible_spec`: an uneven
+    constraint makes GSPMD pad-and-reshard (the "involuntary full rematerialization"
+    warning) instead of erroring, which is strictly worse than replicating that dim.
     """
     rules = nn.get_logical_axis_rules()
     mesh = _ambient_mesh() if rules else None
@@ -118,20 +121,25 @@ def logical_constraint(x, axes):
     for name, target in rules:
         table.setdefault(name, target)
     axis_names = set(mesh.axis_names)
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     entries = []
     used: set[str] = set()  # a mesh axis may shard at most one dim; first dim wins
-    for a in axes:
+    for dim, a in enumerate(axes):
         target = table.get(a) if a is not None else None
         if target is None:
             entries.append(None)
             continue
-        kept = tuple(
-            t
-            for t in (target if isinstance(target, tuple) else (target,))
-            if t in axis_names and t not in used
-        )
+        kept: list[str] = []
+        size = 1
+        for t in target if isinstance(target, tuple) else (target,):
+            if t not in axis_names or t in used:
+                continue
+            if x.shape[dim] % (size * mesh_sizes[t]) != 0:
+                continue
+            kept.append(t)
+            size *= mesh_sizes[t]
         used.update(kept)
-        entries.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        entries.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
     return jax.lax.with_sharding_constraint(x, PartitionSpec(*entries))
 
 

@@ -1,12 +1,29 @@
 from .engine import EngineStats, ServingEngine, serve_batch
-from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
+from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool, shard_kv_caches
 from .prefix_cache import PrefixCache, PrefixMatch, PrefixNode
 from .scheduler import QueueFullError, Request, RequestState, RequestStatus, SamplingParams, Scheduler
 from .speculation import DraftModelDrafter, NgramDrafter
 
+# the distributed tier imports serving.engine, so this must come after it
+from .cluster import (
+    DisaggregatedEngine,
+    EngineReplica,
+    KVHandoff,
+    Router,
+    RouterStats,
+    inference_mesh,
+    inference_sharding_rules,
+    make_sharded_engine,
+    route_batch,
+    shard_params,
+)
+
 __all__ = [
+    "DisaggregatedEngine",
     "DraftModelDrafter",
+    "EngineReplica",
     "EngineStats",
+    "KVHandoff",
     "NgramDrafter",
     "PagedKVCachePool",
     "PrefixCache",
@@ -16,10 +33,18 @@ __all__ = [
     "Request",
     "RequestState",
     "RequestStatus",
+    "Router",
+    "RouterStats",
     "SamplingParams",
     "Scheduler",
     "ServingEngine",
     "SlotKVCachePool",
     "TRASH_PAGE",
+    "inference_mesh",
+    "inference_sharding_rules",
+    "make_sharded_engine",
+    "route_batch",
     "serve_batch",
+    "shard_kv_caches",
+    "shard_params",
 ]

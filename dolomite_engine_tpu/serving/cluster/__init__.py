@@ -1,0 +1,37 @@
+"""Distributed serving tier: TP-sharded replicas, prefill/decode disaggregation, router.
+
+Three layers over the single-process :class:`~dolomite_engine_tpu.serving.ServingEngine`
+(docs/SERVING.md "Distributed serving"):
+
+- :mod:`sharded` — run one engine's jitted prefill/decode/verify programs over a TP
+  (and optionally EP) mesh: params placed per the same logical-axis rules as training,
+  KV pool sharded along kv heads, still exactly one compiled decode step.
+- :mod:`disagg` — DistServe/Splitwise-style prefill/decode disaggregation: a
+  prefill-only engine computes prompts into pages and hands the KV off to a decode
+  worker pool through an explicit :class:`KVHandoff` transfer seam.
+- :mod:`router` — a thin router fronting N engine replicas: admission control and
+  replica selection from the engines' own serving telemetry, with prefix-affinity
+  routing so repeated prompts land where their pages already live.
+"""
+
+from .disagg import DisaggregatedEngine, KVHandoff
+from .router import EngineReplica, Router, RouterStats, route_batch
+from .sharded import (
+    inference_mesh,
+    inference_sharding_rules,
+    make_sharded_engine,
+    shard_params,
+)
+
+__all__ = [
+    "DisaggregatedEngine",
+    "EngineReplica",
+    "KVHandoff",
+    "Router",
+    "RouterStats",
+    "inference_mesh",
+    "inference_sharding_rules",
+    "make_sharded_engine",
+    "route_batch",
+    "shard_params",
+]

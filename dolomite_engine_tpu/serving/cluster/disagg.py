@@ -1,0 +1,208 @@
+"""Prefill/decode disaggregation: a prefill worker pool feeding decode workers via KV handoff.
+
+Prefill and decode have opposite hardware appetites — prefill is compute-bound (big
+matmuls over whole prompts), decode is memory-bandwidth-bound (one token per step over a
+large KV pool) — so production serving splits them onto separately-scaled pools
+(DistServe, Zhong et al. 2024; Splitwise, Patel et al. 2024). The pieces here:
+
+- **PrefillWorker** — a :class:`~..engine.ServingEngine` in ``prefill_only`` mode: it
+  admits, chunk-prefills into its paged pool, streams the first token, then parks the
+  finished prefill for handoff instead of decoding.
+- **KVHandoff** — the explicit transfer seam: copy a request's prefix pages from the
+  prefill pool into freshly-allocated pages of a decode pool. The in-process
+  implementation is one jitted gather/scatter over the page dim (device-to-device on a
+  shared host; page-index vectors are padded to a fixed width so it compiles once).
+  This interface is where an ICI/DCN transfer lands when workers span hosts.
+- **DecodeWorker** — any plain paged engine: `ServingEngine.adopt_prefilled` installs
+  the transferred request exactly as a local final prefill chunk would have, so decode
+  is token-for-token identical to the monolithic engine.
+- **DisaggregatedEngine** — composes one prefill engine and N decode workers behind the
+  ServingEngine driver interface (submit/step/drain/has_work), placing handoffs FCFS
+  onto the least-loaded worker with capacity.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ...utils.telemetry import get_telemetry
+from ..engine import ServingEngine
+from ..kv_cache import KVCacheList, PagedKVCachePool, TRASH_PAGE
+from ..scheduler import RequestState
+
+
+def _copy_pages(dst_caches: KVCacheList, src_caches: KVCacheList, dst_index, src_index):
+    """Scatter `src` pages onto `dst` pages in every layer. Index vectors have a fixed
+    padded width; pad lanes map trash->trash (page 0 on both sides), where duplicate
+    writes are harmless by the trash-page contract."""
+    out = []
+    for dst, src in zip(dst_caches, src_caches):
+        out.append(
+            {
+                "k": dst["k"].at[dst_index].set(src["k"][src_index]),
+                "v": dst["v"].at[dst_index].set(src["v"][src_index]),
+            }
+        )
+    return out
+
+
+class KVHandoff:
+    """Device-to-device page transfer between two paged pools (the disaggregation seam).
+
+    One jitted copy program per (src pool, dst pool) pair of shapes — index vectors are
+    padded to the destination's ``max_pages_per_slot``, so any request transfers through
+    the same compiled program. Tracks a rolling handoff-latency gauge (transfer + adopt
+    bookkeeping, host wall clock).
+    """
+
+    def __init__(self) -> None:
+        self._copy_fn = jax.jit(_copy_pages, donate_argnums=(0,))
+        self.transfers = 0
+        self.last_latency_s = 0.0
+        self._latency_sum = 0.0
+
+    @property
+    def mean_latency_s(self) -> float:
+        return self._latency_sum / self.transfers if self.transfers else 0.0
+
+    def transfer(
+        self,
+        src_pool: PagedKVCachePool,
+        src_pages: list[int],
+        dst_pool: PagedKVCachePool,
+        dst_pages: list[int],
+    ) -> None:
+        if src_pool.page_size != dst_pool.page_size:
+            raise ValueError(
+                f"KV handoff needs equal page sizes, got {src_pool.page_size} -> "
+                f"{dst_pool.page_size}"
+            )
+        assert len(src_pages) == len(dst_pages), (src_pages, dst_pages)
+        width = dst_pool.max_pages_per_slot
+        assert len(dst_pages) <= width, "handoff exceeds the destination slot's pages"
+        src_index = np.full(width, TRASH_PAGE, np.int32)
+        dst_index = np.full(width, TRASH_PAGE, np.int32)
+        src_index[: len(src_pages)] = src_pages
+        dst_index[: len(dst_pages)] = dst_pages
+        t0 = time.perf_counter()
+        dst_pool.caches = self._copy_fn(
+            dst_pool.caches, src_pool.caches, jax.numpy.asarray(dst_index), jax.numpy.asarray(src_index)
+        )
+        jax.block_until_ready(dst_pool.caches[0]["k"])
+        self.record_latency(time.perf_counter() - t0)
+
+    def record_latency(self, seconds: float) -> None:
+        self.transfers += 1
+        self.last_latency_s = seconds
+        self._latency_sum += seconds
+        get_telemetry().count("cluster_kv_handoffs")
+        get_telemetry().gauge("cluster/handoff_latency_ms", round(seconds * 1e3, 3))
+
+
+class DisaggregatedEngine:
+    """One prefill engine + N decode workers behind the ServingEngine driver interface.
+
+    ``submit`` enqueues on the prefill side; each ``step`` advances prefill, moves
+    finished prefills (FCFS — if the head fits no worker, nothing skips ahead of it)
+    onto the decode worker with the lowest load, then steps every decode worker.
+    Deadlines keep working across the boundary: both sides share one clock and the
+    request's original ``submit_t``.
+    """
+
+    def __init__(
+        self,
+        prefill_engine: ServingEngine,
+        decode_engines: list[ServingEngine],
+        handoff: KVHandoff | None = None,
+    ) -> None:
+        if not prefill_engine.prefill_only:
+            raise ValueError("prefill_engine must be constructed with prefill_only=True")
+        if not decode_engines:
+            raise ValueError("need at least one decode engine")
+        for engine in decode_engines:
+            if not engine.paged or engine.prefill_only:
+                raise ValueError("decode engines must be paged, non-prefill_only")
+            if engine.pool.page_size != prefill_engine.pool.page_size:
+                raise ValueError("prefill and decode pools must share a page size")
+        self.prefill = prefill_engine
+        self.workers = decode_engines
+        self.handoff = KVHandoff() if handoff is None else handoff
+
+    # ------------------------------------------------------------- driver interface
+
+    def submit(self, *args: Any, **kwargs: Any) -> RequestState:
+        return self.prefill.submit(*args, **kwargs)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.prefill.scheduler.queue_depth
+
+    @property
+    def occupancy(self) -> float:
+        return sum(w.pool.occupancy for w in self.workers) / len(self.workers)
+
+    def prefix_match_len(self, prompt_ids: list[int]) -> int:
+        # affinity means "prefill is cheap here": the prefill engine owns the index
+        return self.prefill.prefix_match_len(prompt_ids)
+
+    def has_work(self) -> bool:
+        return (
+            self.prefill.has_work()
+            or self.prefill.pending_handoffs > 0
+            or any(w.has_work() for w in self.workers)
+        )
+
+    def step(self) -> bool:
+        self.prefill.step()
+        self._place_handoffs()
+        for worker in self.workers:
+            if worker.has_work():
+                worker.step()
+        return self.has_work()
+
+    def drain(self) -> None:
+        while self.step():
+            pass
+        self.emit_serving_record()
+
+    def emit_serving_record(self) -> None:
+        self.prefill.emit_serving_record()
+        for worker in self.workers:
+            worker.emit_serving_record()
+
+    # ------------------------------------------------------------------- internals
+
+    def _place_handoffs(self) -> None:
+        from ..scheduler import RequestStatus
+
+        ready = self.prefill.take_ready_handoffs()
+        for index, state in enumerate(ready):
+            if self.prefill.scheduler.expired(state):
+                # deadline lapsed while parked: cancel on the prefill side (frees pages)
+                self.prefill._finish(state, RequestStatus.cancelled)
+                continue
+            src_slot = state.slot  # adopt_prefilled repoints state.slot at the decode slot
+            first_token, carry, length, src_pages = self.prefill.handoff_payload(state)
+            placed = False
+            for worker in sorted(self.workers, key=lambda w: (w.pool.occupancy, id(w))):
+                dst_pages = worker.adopt_prefilled(
+                    state, first_token=first_token, rng_carry=carry, length=length
+                )
+                if dst_pages is not None:
+                    self.handoff.transfer(self.prefill.pool, src_pages, worker.pool, dst_pages)
+                    self.prefill.release_handoff(state, src_slot)
+                    placed = True
+                    break
+            if placed:
+                continue
+            # head doesn't fit anywhere: park everything back, preserving FCFS order
+            for waiter in reversed(ready[index:]):
+                self.prefill.park_handoff(waiter)
+            return
+
+
+__all__ = ["DisaggregatedEngine", "KVHandoff"]

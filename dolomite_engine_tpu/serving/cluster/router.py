@@ -1,0 +1,298 @@
+"""Telemetry-driven request router over N engine replicas.
+
+The router is the batch-parallel layer of the serving tier: each replica is a whole
+engine (single-device, TP-sharded, or disaggregated) owning its own KV pool and queue;
+the router does admission control and replica selection using the same signals the
+engines already export as serving telemetry:
+
+- **prefix affinity first**: the replica whose prefix index holds the longest resident
+  prefix for the prompt (probed side-effect-free via `prefix_match_len`) wins when the
+  match covers at least one full KV page — re-prefilling a resident prefix elsewhere
+  costs more than any load imbalance at page granularity;
+- **least-loaded otherwise**: (queue depth, slot occupancy) lexicographic, replica id as
+  the deterministic tie-break;
+- **admission control**: a replica at its queue bound is skipped; when every replica is
+  full the router rejects (`QueueFullError`) instead of buffering unboundedly — exactly
+  the engine's own backpressure contract, one level up.
+
+Replicas step either synchronously (`Router.step`/`drain` — deterministic, what the
+tests and batch drivers use) or on background threads (`start`/`wait`/`stop` — the
+CPU-testable proof-of-concept of independently-running replicas; per-replica locks keep
+submit and step serialized per engine).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ...utils.telemetry import get_telemetry
+from ..scheduler import QueueFullError, RequestState
+from .disagg import DisaggregatedEngine
+
+
+@dataclass
+class RouterStats:
+    routed: int = 0
+    rejected: int = 0
+    affinity_hits: int = 0
+    per_replica_routed: dict[int, int] = field(default_factory=dict)
+
+    def affinity_hit_rate(self) -> float | None:
+        return self.affinity_hits / self.routed if self.routed else None
+
+
+class EngineReplica:
+    """One routable engine (ServingEngine or DisaggregatedEngine) plus its worker thread.
+
+    The lock serializes `submit` (router thread) against `step` (replica thread) — the
+    engines are host-side single-threaded by design. In synchronous mode the lock is
+    uncontended and free.
+    """
+
+    def __init__(self, replica_id: int, engine: Any) -> None:
+        self.replica_id = replica_id
+        self.engine = engine
+        # stamp the id on every underlying engine so their serving records carry it
+        if isinstance(engine, DisaggregatedEngine):
+            engine.prefill.replica_id = replica_id
+            for worker in engine.workers:
+                worker.replica_id = replica_id
+        else:
+            engine.replica_id = replica_id
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------ signals
+
+    @property
+    def queue_depth(self) -> int:
+        engine = self.engine
+        if isinstance(engine, DisaggregatedEngine):
+            return engine.queue_depth
+        return engine.scheduler.queue_depth
+
+    @property
+    def occupancy(self) -> float:
+        engine = self.engine
+        if isinstance(engine, DisaggregatedEngine):
+            return engine.occupancy
+        return engine.pool.occupancy
+
+    @property
+    def slots_active(self) -> int:
+        engine = self.engine
+        if isinstance(engine, DisaggregatedEngine):
+            return sum(w.pool.num_active for w in engine.workers)
+        return engine.pool.num_active
+
+    @property
+    def page_size(self) -> int:
+        engine = self.engine
+        pool = engine.prefill.pool if isinstance(engine, DisaggregatedEngine) else engine.pool
+        return getattr(pool, "page_size", 0)
+
+    def prefix_match_len(self, prompt_ids: list[int]) -> int:
+        with self._lock:
+            return self.engine.prefix_match_len(prompt_ids)
+
+    def load(self) -> tuple[int, float, int]:
+        return (self.queue_depth, self.occupancy, self.replica_id)
+
+    # ------------------------------------------------------------------ driving
+
+    def submit(self, **spec: Any) -> RequestState:
+        with self._lock:
+            return self.engine.submit(**spec)
+
+    def step(self) -> bool:
+        with self._lock:
+            if not self.engine.has_work():
+                return False
+            return bool(self.engine.step())
+
+    def has_work(self) -> bool:
+        return self.engine.has_work()
+
+    def start(self) -> None:
+        assert self._thread is None, "replica thread already running"
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                if not self.step():
+                    time.sleep(0.002)  # idle: yield instead of spinning on the lock
+
+        self._thread = threading.Thread(
+            target=loop, name=f"replica-{self.replica_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+
+
+class Router:
+    """Admission control + replica selection over a replica fleet (see module docs)."""
+
+    def __init__(
+        self,
+        replicas: list[EngineReplica],
+        *,
+        record_interval: int = 0,
+    ) -> None:
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas = replicas
+        self.record_interval = record_interval
+        self.stats = RouterStats()
+        self._last_record_routed = 0
+
+    # ------------------------------------------------------------------ routing
+
+    def select(self, prompt_ids: list[int]) -> tuple[EngineReplica, bool]:
+        """Pick a replica for `prompt_ids`: (replica, used_prefix_affinity)."""
+        best: EngineReplica | None = None
+        best_len = 0
+        for replica in self.replicas:
+            match = replica.prefix_match_len(prompt_ids)
+            if match > best_len:
+                best, best_len = replica, match
+        if best is not None and best_len >= best.page_size > 0:
+            return best, True
+        return min(self.replicas, key=lambda r: r.load()), False
+
+    def submit(self, **spec: Any) -> RequestState:
+        """Route one request spec (the kwargs of `ServingEngine.submit`). Raises
+        QueueFullError only when EVERY replica is at its admission bound."""
+        chosen, affinity = self.select(spec["prompt_ids"])
+        candidates = [chosen] + sorted(
+            (r for r in self.replicas if r is not chosen), key=lambda r: r.load()
+        )
+        for replica in candidates:
+            try:
+                state = replica.submit(**spec)
+            except QueueFullError:
+                continue
+            self.stats.routed += 1
+            self.stats.per_replica_routed[replica.replica_id] = (
+                self.stats.per_replica_routed.get(replica.replica_id, 0) + 1
+            )
+            get_telemetry().count("router_requests_routed")
+            if affinity and replica is chosen:
+                self.stats.affinity_hits += 1
+                get_telemetry().count("router_prefix_affinity_hits")
+            if (
+                self.record_interval
+                and self.stats.routed - self._last_record_routed >= self.record_interval
+            ):
+                self.emit_router_record()
+            return state
+        self.stats.rejected += 1
+        get_telemetry().count("router_requests_rejected")
+        raise QueueFullError(
+            f"all {len(self.replicas)} replica(s) are at their admission bound"
+        )
+
+    # ------------------------------------------------------------------ driving
+
+    def step(self) -> bool:
+        """Synchronous mode: advance every replica with pending work one engine step."""
+        worked = False
+        for replica in self.replicas:
+            worked = replica.step() or worked
+        return worked
+
+    def drain(self) -> None:
+        """Run until every routed request finished; emit serving + router records."""
+        while self.step():
+            pass
+        for replica in self.replicas:
+            replica.engine.emit_serving_record()
+        self.emit_router_record()
+
+    def start(self) -> None:
+        """Threaded mode: one background stepping thread per replica."""
+        for replica in self.replicas:
+            replica.start()
+
+    def wait(self, timeout_s: float = 60.0) -> bool:
+        """Block until every replica is idle (threaded mode). True = drained."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not any(r.has_work() for r in self.replicas):
+                return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self) -> None:
+        for replica in self.replicas:
+            replica.stop()
+
+    # ------------------------------------------------------------------ telemetry
+
+    def emit_router_record(self) -> None:
+        """One ``router`` telemetry record: instantaneous per-replica queue/slot state
+        plus cumulative routing counters (docs/OBSERVABILITY.md)."""
+        telemetry = get_telemetry()
+        self._last_record_routed = self.stats.routed
+        queue_depths = [r.queue_depth for r in self.replicas]
+        telemetry.gauge("router/queue_depth", sum(queue_depths))
+        handoffs = [
+            r.engine.handoff
+            for r in self.replicas
+            if isinstance(r.engine, DisaggregatedEngine)
+        ]
+        transfers = sum(h.transfers for h in handoffs)
+        latency_ms = (
+            round(1e3 * sum(h._latency_sum for h in handoffs) / transfers, 3)
+            if transfers
+            else None
+        )
+        hit_rate = self.stats.affinity_hit_rate()
+        telemetry.emit_record(
+            "router",
+            replicas=len(self.replicas),
+            queue_depths=queue_depths,
+            slots_active=[r.slots_active for r in self.replicas],
+            routed=self.stats.routed,
+            rejected=self.stats.rejected,
+            prefix_affinity_hits=self.stats.affinity_hits,
+            handoff_latency_ms=latency_ms,
+            counters={
+                "per_replica_routed": {
+                    str(k): v for k, v in sorted(self.stats.per_replica_routed.items())
+                },
+                "prefix_affinity_hit_rate": None if hit_rate is None else round(hit_rate, 4),
+                "kv_handoffs": transfers,
+            },
+        )
+
+
+def route_batch(router: Router, request_specs: list[dict]) -> list[RequestState]:
+    """Offline driver: route every spec with backpressure (a full fleet makes room by
+    stepping) and drain. Results keep submission order — the router-level analogue of
+    `serving.engine.serve_batch`."""
+    states: list[RequestState] = []
+    index = 0
+    while index < len(request_specs):
+        try:
+            states.append(router.submit(**request_specs[index]))
+            index += 1
+        except QueueFullError:
+            if not router.step():  # nothing progressed and everything is full: bug guard
+                raise
+    router.drain()
+    return states
+
+
+__all__ = ["EngineReplica", "Router", "RouterStats", "route_batch"]

@@ -1,0 +1,108 @@
+"""TP/EP-sharded serving replicas: the engine's jits over a device mesh.
+
+The reference engine swaps in ``GPTDolomiteForCausalLM_TP`` for sharded inference
+(`tools/tensor_parallel_inference.py`); under GSPMD there is no ``_TP`` class — the same
+flax module runs tensor-parallel when (a) its params are placed per the TP logical-axis
+rules (`parallel/sharding.py`), (b) tracing happens under an ambient mesh + rules scope
+so the models' `logical_constraint` calls bind, and (c) the KV pool is sharded along kv
+heads (`serving/kv_cache.shard_kv_caches`). :class:`~..engine.ServingEngine` grew
+``mesh=`` / ``sharding_rules=`` kwargs for (b)+(c); this module supplies (a) plus the
+mesh/rules builders, so a sharded replica is::
+
+    mesh = inference_mesh(tensor_parallel_size=2, devices=jax.devices()[:2])
+    rules = inference_sharding_rules()
+    engine = make_sharded_engine(model, params, mesh=mesh, rules=rules, num_slots=8, ...)
+
+Replicas of a router fleet pass disjoint ``devices`` so each owns its slice of the
+machine; `decode_compiles == 1` and token-for-token parity with the unsharded engine
+hold per replica (tests/test_serving_cluster.py asserts both bit-exact).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from ...parallel.mesh import MESH_AXES
+from ...parallel.sharding import (
+    LogicalRules,
+    get_logical_axis_rules,
+    logical_to_mesh_sharding,
+    prune_indivisible_shardings,
+)
+from ..engine import ServingEngine
+
+
+def inference_mesh(
+    tensor_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a serving mesh (dp=1, fsdp=1, sp=1, tp, ep) over `devices`.
+
+    Unlike `MeshManager` this does NOT touch the global singleton: a router fleet
+    builds one mesh per replica over disjoint device subsets. `devices` defaults to the
+    first ``tp * ep`` visible devices; its length must equal ``tp * ep`` exactly (data
+    axes stay 1 — batch parallelism across devices is the ROUTER's job, done with whole
+    replicas, not GSPMD).
+    """
+    need = tensor_parallel_size * expert_parallel_size
+    if devices is None:
+        devices = jax.devices()[:need]
+    if len(devices) != need:
+        raise ValueError(
+            f"inference mesh needs exactly tp*ep = {need} device(s), got {len(devices)}"
+        )
+    shape = (1, 1, 1, tensor_parallel_size, expert_parallel_size)
+    return Mesh(np.asarray(devices).reshape(shape), MESH_AXES)
+
+
+def inference_sharding_rules(tensor_parallel_word_embeddings: bool = False) -> LogicalRules:
+    """Logical-axis rules for serving: TP/EP shard the weights, everything else is
+    replicated (stage 0 — there is no optimizer state and the fsdp axis is size 1)."""
+    return get_logical_axis_rules(
+        stage=0, tensor_parallel_word_embeddings=tensor_parallel_word_embeddings
+    )
+
+
+def shard_params(model: Any, params: Any, mesh: Mesh, rules: LogicalRules | None = None) -> Any:
+    """Place an (unboxed) param tree on `mesh` per the model's logical specs.
+
+    The specs come from one abstract init trace (no real weights materialized); axes
+    that don't divide their mesh dimension fall back to replication
+    (`prune_indivisible_shardings`). `ModelWrapper.load_pretrained_params` already
+    places checkpoint weights this way — this helper is for params that exist in host
+    memory or on another mesh (tests, weight hot-swap, replica cloning).
+    """
+    rules = inference_sharding_rules() if rules is None else rules
+    boxed = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jax.numpy.zeros((1, 8), jax.numpy.int32))
+    )["params"]
+    specs = nn.get_partition_spec({"params": boxed})["params"]
+    shardings = logical_to_mesh_sharding(specs, mesh, rules)
+    shardings = prune_indivisible_shardings(nn.unbox(boxed), shardings, mesh)
+    # a raw `model.init` tree still carries LogicallyPartitioned boxes; runtime trees
+    # are unboxed everywhere in this repo (ModelWrapper.init_params does the same)
+    return jax.tree.map(jax.device_put, nn.unbox(params), shardings)
+
+
+def make_sharded_engine(
+    model: Any,
+    params: Any,
+    *,
+    mesh: Mesh,
+    rules: LogicalRules | None = None,
+    params_already_placed: bool = False,
+    **engine_kwargs: Any,
+) -> ServingEngine:
+    """One TP-sharded engine replica: shard `params` onto `mesh` (unless the caller
+    already placed them, e.g. via `load_pretrained_params`) and construct the engine
+    with the mesh + rules threaded through every jitted program."""
+    rules = inference_sharding_rules() if rules is None else rules
+    if not params_already_placed:
+        params = shard_params(model, params, mesh, rules)
+    return ServingEngine(model, params, mesh=mesh, sharding_rules=rules, **engine_kwargs)

@@ -44,6 +44,7 @@ tests/test_serving.py + tests/test_serving_paged.py for the bit-exact parity sui
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -51,6 +52,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax import linen as nn
 
 from ..ops.pallas import active_kernel_backends
 from ..ops.sampling import sample_tokens_vectorized, speculative_accept
@@ -178,6 +180,20 @@ class ServingEngine:
         draft_k: draft tokens proposed per engine step (K >= 1); the verify step scores
             K+1 positions per slot and compiles once per engine lifetime.
         ngram_max: longest suffix length tried by the n-gram drafter (down to 1).
+        mesh: run every jitted engine program (prefill chunks, decode, verify) under this
+            device mesh — the TP/EP-sharded replica path (serving/cluster/sharded.py).
+            Params must already be placed per the mesh (`load_pretrained_params` /
+            `cluster.sharded.shard_params`); the KV pool is sharded along kv heads.
+        sharding_rules: logical-axis rules bound while tracing under `mesh` (the
+            engine-side mirror of `ModelWrapper.apply_scope`), so the models'
+            `logical_constraint` calls resolve. Required when `mesh` is given.
+        replica_id: stamped on every ``serving`` telemetry record — which replica of a
+            router fleet (serving/cluster/router.py) produced it. None = standalone.
+        prefill_only: run this engine as a disaggregation PrefillWorker (paged mode
+            only): requests are admitted and chunk-prefilled as usual, the first token
+            streams out, but instead of decoding, finished prefills park for
+            `take_ready_handoffs` — a DecodeWorker adopts the KV pages via
+            `serving/cluster/disagg.KVHandoff`.
     """
 
     def __init__(
@@ -205,7 +221,20 @@ class ServingEngine:
         draft_params: Any = None,
         draft_k: int = 4,
         ngram_max: int = 3,
+        mesh: Any = None,
+        sharding_rules: Any = None,
+        replica_id: int | None = None,
+        prefill_only: bool = False,
     ) -> None:
+        if mesh is not None and sharding_rules is None:
+            raise ValueError(
+                "mesh requires sharding_rules (ModelWrapper.sharding_rules() or "
+                "cluster.sharded.inference_sharding_rules())"
+            )
+        if prefill_only and not paged:
+            raise ValueError("prefill_only (disaggregation) requires the paged KV pool")
+        if prefill_only and (speculate_ngram or draft_model is not None):
+            raise ValueError("prefill_only workers do not decode, so cannot speculate")
         if prefill_bucket_multiple <= 0 or prefill_bucket_multiple % 8 != 0:
             raise ValueError(
                 f"prefill_bucket_multiple must be a positive multiple of 8, got "
@@ -232,14 +261,21 @@ class ServingEngine:
         self.prefill_bucket_multiple = prefill_bucket_multiple
         self.record_interval = record_interval
         self.paged = paged
+        self.mesh = mesh
+        self.sharding_rules = sharding_rules
+        self.replica_id = replica_id
+        self.prefill_only = prefill_only
+        # prefill-only mode: finished prefills parked here (slot + pages still resident)
+        # until a DecodeWorker adopts their KV (serving/cluster/disagg.py)
+        self._ready_handoffs: list[RequestState] = []
 
         if paged:
             self.pool: Any = PagedKVCachePool(
-                model, num_slots, max_len, page_size, num_pages, cache_dtype
+                model, num_slots, max_len, page_size, num_pages, cache_dtype, mesh=mesh
             )
             self.prefix = PrefixCache(page_size) if prefix_caching else None
         else:
-            self.pool = SlotKVCachePool(model, num_slots, max_len, cache_dtype)
+            self.pool = SlotKVCachePool(model, num_slots, max_len, cache_dtype, mesh=mesh)
             self.prefix = None
         self.scheduler = Scheduler(
             max_waiting=max_waiting, clock=clock, prefill_chunk_tokens=prefill_chunk_tokens
@@ -294,6 +330,18 @@ class ServingEngine:
         self._verify_step = (
             jax.jit(verify_impl, donate_argnums=(1,)) if self.speculating else None
         )
+
+    def _scope(self):
+        """Context every device call runs under: the replica's mesh (classic resource
+        env, which `parallel.sharding.logical_constraint` resolves inside jit) plus the
+        logical-axis rules. Meshless engines get a no-op stack, so the single-device
+        path is untouched. Tracing happens on each jit's first call — always inside
+        `step()`/admission, hence always inside this scope."""
+        stack = contextlib.ExitStack()
+        if self.mesh is not None:
+            stack.enter_context(self.mesh)
+            stack.enter_context(nn.logical_axis_rules(self.sharding_rules))
+        return stack
 
     # ------------------------------------------------------------------ jitted programs
 
@@ -501,15 +549,36 @@ class ServingEngine:
     # ------------------------------------------------------------------ engine loop
 
     def has_work(self) -> bool:
-        return bool(self._slot_states) or self.scheduler.queue_depth > 0
+        """Whether stepping can still make progress. Parked handoffs (prefill_only) are
+        NOT progressable work — a DecodeWorker has to adopt them — so a drained
+        PrefillWorker with only parked slots reports idle instead of spinning."""
+        if self.scheduler.queue_depth > 0:
+            return True
+        parked = {state.slot for state in self._ready_handoffs}
+        return any(slot not in parked for slot in self._slot_states)
 
     def step(self) -> bool:
         """One scheduler iteration: reap deadline-expired slots, admit waiting requests
         into free slots, advance chunked prefills up to the budget (paged mode), run one
         decode step over the slot batch. Returns whether any work remains."""
+        with self._scope():
+            self._step_in_scope()
+        if (
+            self.record_interval
+            and self._step_count - self._last_record_step >= self.record_interval
+        ):
+            self.emit_serving_record()
+        return self.has_work()
+
+    def _step_in_scope(self) -> None:
         self._cancel_expired_running()
         if self.paged:
             self._admit_paged()
+            if self.prefill_only:
+                # no decode competes for the budget; parked handoff slots never decode
+                self._run_prefill_chunks(self.scheduler.prefill_chunk_tokens)
+                self.stats.peak_active = max(self.stats.peak_active, self.pool.num_active)
+                return
             # decode's computed tokens count against the shared per-step budget: a plain
             # decode costs 1 token per decoding slot, a verify step K+1 (it really does
             # score the whole window) — prefill chunks get what is left
@@ -529,12 +598,6 @@ class ServingEngine:
                 else:
                     self._decode_once()
         self.stats.peak_active = max(self.stats.peak_active, self.pool.num_active)
-        if (
-            self.record_interval
-            and self._step_count - self._last_record_step >= self.record_interval
-        ):
-            self.emit_serving_record()
-        return self.has_work()
 
     def drain(self) -> None:
         """Run until every submitted request finished; emit a final serving record."""
@@ -785,6 +848,10 @@ class ServingEngine:
                 if self.speculating:
                     self._spec_start(slot, prompt)
                 self._deliver(state, first_token)
+                if self.prefill_only and not state.done:
+                    # park for handoff: the slot (and its pages) stays resident until a
+                    # DecodeWorker adopts the KV and `release_handoff` frees it
+                    self._ready_handoffs.append(state)
 
     def _decode_once_paged(self) -> None:
         decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
@@ -1033,6 +1100,8 @@ class ServingEngine:
     def _finish(self, state: RequestState, status: RequestStatus) -> None:
         state.status = status
         state.finish_t = self.scheduler.clock()
+        if self._ready_handoffs:
+            self._ready_handoffs = [s for s in self._ready_handoffs if s is not state]
         if state.slot is not None:
             slot = state.slot
             self._prefill_tasks.pop(slot, None)
@@ -1065,6 +1134,97 @@ class ServingEngine:
             resident[:written], [int(p) for p in self.pool.page_table[slot]], self.pool
         )
 
+    # ------------------------------------------------------ disaggregation (cluster/)
+
+    def prefix_match_len(self, prompt_ids: list[int]) -> int:
+        """Resident-prefix tokens this engine could reuse for `prompt_ids` — the
+        router's affinity probe. Side-effect free (no LRU promotion); 0 when prefix
+        caching is off."""
+        return 0 if self.prefix is None else self.prefix.probe_len(prompt_ids)
+
+    @property
+    def pending_handoffs(self) -> int:
+        """Finished prefills parked for adoption (prefill_only mode; else 0)."""
+        return len(self._ready_handoffs)
+
+    def take_ready_handoffs(self) -> list[RequestState]:
+        """Pop every parked finished prefill (FCFS order). prefill_only mode only; the
+        caller must `handoff_payload` + transfer + `release_handoff` each one (or
+        re-park via `park_handoff` when no DecodeWorker has capacity)."""
+        ready, self._ready_handoffs = self._ready_handoffs, []
+        return ready
+
+    def park_handoff(self, state: RequestState) -> None:
+        """Return an un-placeable handoff to the FRONT of the parked queue (FCFS)."""
+        self._ready_handoffs.insert(0, state)
+
+    def handoff_payload(self, state: RequestState) -> tuple[int, np.ndarray, int, list[int]]:
+        """Host-side handoff bundle for a parked prefill: (first_token, rng_carry,
+        resident_length, physical source pages in chain order). The pages stay alive —
+        and their K/V unchanged — until `release_handoff`."""
+        slot = state.slot
+        assert slot is not None, "handoff payload for a request without a slot"
+        length = int(self.pool.lengths[slot])
+        used = -(-length // self.pool.page_size)
+        pages = [int(p) for p in self.pool.page_table[slot, :used]]
+        assert TRASH_PAGE not in pages, "handoff of an unmapped prefix page"
+        return int(self._tokens[slot]), self._rngs[slot].copy(), length, pages
+
+    def release_handoff(self, state: RequestState, slot: int) -> None:
+        """Free a handed-off request's source `slot` WITHOUT finishing the request: its
+        prefix pages are registered in the local prefix index first (future arrivals
+        with the same prompt skip prefill here — which is what makes prefill affinity
+        work), then the slot and its remaining reservation return to the pool. The slot
+        is passed explicitly because `adopt_prefilled` on the decode side has already
+        repointed ``state.slot`` at the destination."""
+        assert self._slot_states.get(slot) is state, "release of a slot the state does not hold"
+        if self.prefix is not None:
+            self._register_prefix(state, slot)
+        self.pool.free(slot)
+        del self._slot_states[slot]
+
+    def adopt_prefilled(self, state: RequestState, *, first_token: int, rng_carry, length: int) -> list[int] | None:
+        """Admit a request whose prefill ran on another engine (the DecodeWorker side of
+        disaggregation). Reserves the request's remaining worst-case pages, maps `used`
+        fresh private pages for the transferred prefix, and installs the decode-loop
+        state exactly as a local final prefill chunk would have — so decode from here is
+        token-for-token identical to the monolithic engine. Returns the destination
+        physical pages (chain order) for the KVHandoff to fill, or None when this
+        worker lacks slot/page capacity (the caller keeps FCFS by re-parking)."""
+        assert self.paged and not self.prefill_only
+        request = state.request
+        page_size = self.pool.page_size
+        used = -(-length // page_size)
+        worst = -(-(length + request.max_new_tokens) // page_size)
+        if self.pool.num_free == 0:
+            return None
+        shortfall = worst - self.pool.available_pages
+        if shortfall > 0 and self.prefix is not None:
+            self.prefix.evict(shortfall, self.pool)
+        if worst > self.pool.available_pages:
+            return None
+        slot = self.pool.allocate()
+        self.pool.reserve(slot, worst)
+        pages = [self.pool.alloc_page(slot, i) for i in range(used)]
+        self.pool.lengths[slot] = length
+
+        do_sample, temperature, top_k, top_p = request.sampling.encoded()
+        state.slot = slot
+        state.status = RequestStatus.running
+        self._slot_states[slot] = state
+        self._tokens[slot] = first_token
+        self._rngs[slot] = np.asarray(rng_carry)
+        self._do_sample[slot] = do_sample
+        self._temperature[slot] = temperature
+        self._top_k[slot] = top_k
+        self._top_p[slot] = top_p
+        if self.speculating:
+            # the drafter's history must include tokens the prefill side already emitted
+            self._spec_start(slot, request.prompt_ids + state.tokens)
+        self.stats.admitted += 1
+        get_telemetry().count("serving_requests_admitted")
+        return pages
+
     # ------------------------------------------------------------------ telemetry
 
     def emit_serving_record(self) -> None:
@@ -1095,6 +1255,7 @@ class ServingEngine:
         telemetry.emit_record(
             "serving",
             step=self._step_count,
+            replica_id=self.replica_id,
             queue_depth=self.scheduler.queue_depth,
             slots_active=self.pool.num_active,
             num_slots=self.pool.num_slots,

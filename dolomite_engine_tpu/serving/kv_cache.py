@@ -25,10 +25,41 @@ from typing import Any
 
 import jax
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 KVCacheList = list[Any]  # per-layer {"k": [S, L, H, D], "v": ...} (models/modeling_utils)
 
 TRASH_PAGE = 0  # page-table sentinel: unmapped logical page / garbage-write target
+
+
+def shard_kv_caches(caches: KVCacheList, mesh: Mesh | None) -> KVCacheList:
+    """Place a pool's K/V arrays with the kv-heads dim split over the mesh "tp" axis.
+
+    Both pool layouts put heads at dim 2 (dense ``[slots, len, H, D]``, paged
+    ``[pages, page, H, D]``), mirroring the model's ``act_kv_heads -> tp`` activation
+    rule so the sharded decode step reads/writes its local head shard without
+    collectives. Heads that don't divide tp fall back to replication (the same escape
+    hatch as `parallel.sharding.prune_indivisible_spec`); no mesh is a no-op.
+    """
+    if mesh is None:
+        return caches
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+    out = []
+    for cache in caches:
+        heads = cache["k"].shape[2]
+        spec = (
+            PartitionSpec(None, None, "tp", None)
+            if tp > 1 and heads % tp == 0
+            else PartitionSpec()
+        )
+        sharding = NamedSharding(mesh, spec)
+        out.append(
+            {
+                "k": jax.device_put(cache["k"], sharding),
+                "v": jax.device_put(cache["v"], sharding),
+            }
+        )
+    return out
 
 
 class SlotKVCachePool:
@@ -38,11 +69,15 @@ class SlotKVCachePool:
     jitted decode step and reassigned from its output); allocation state lives on host.
     """
 
-    def __init__(self, model: Any, num_slots: int, max_len: int, dtype=None) -> None:
+    def __init__(
+        self, model: Any, num_slots: int, max_len: int, dtype=None, mesh: Mesh | None = None
+    ) -> None:
         assert num_slots > 0 and max_len > 0, (num_slots, max_len)
         self.num_slots = num_slots
         self.max_len = max_len
-        self.caches: KVCacheList = model.init_kv_caches(num_slots, max_len, dtype)
+        self.caches: KVCacheList = shard_kv_caches(
+            model.init_kv_caches(num_slots, max_len, dtype), mesh
+        )
         # pop() from the tail; reversed so slot 0 is handed out first (deterministic tests)
         self._free: list[int] = list(reversed(range(num_slots)))
         self._in_use: set[int] = set()
@@ -141,6 +176,7 @@ class PagedKVCachePool:
         page_size: int,
         num_pages: int | None = None,
         dtype=None,
+        mesh: Mesh | None = None,
     ) -> None:
         assert num_slots > 0 and max_len > 0, (num_slots, max_len)
         if page_size <= 0 or page_size % 8 != 0:
@@ -160,7 +196,9 @@ class PagedKVCachePool:
 
         # pages, not slot rows: [num_pages, page_size, H, D] per layer — same
         # init_kv_caches layout with "batch" = pages and "length" = page_size
-        self.caches: KVCacheList = model.init_kv_caches(num_pages, page_size, dtype)
+        self.caches: KVCacheList = shard_kv_caches(
+            model.init_kv_caches(num_pages, page_size, dtype), mesh
+        )
         self.page_table = np.zeros((num_slots, self.max_pages_per_slot), np.int32)
         self.lengths = np.zeros(num_slots, np.int32)
         self.refcounts = np.zeros(num_pages, np.int32)

@@ -112,6 +112,22 @@ class PrefixCache:
         resume = min(pos + cow_len, prompt_len - 1)
         return PrefixMatch(nodes=nodes, cow=cow, cow_len=cow_len, resume_pos=resume)
 
+    def probe_len(self, prompt_ids: list[int]) -> int:
+        """Resident-prefix length for `prompt_ids` WITHOUT touching LRU clocks — the
+        router's affinity probe (serving/cluster/router.py) must not promote entries it
+        is merely considering, or probing N replicas would wreck every replica's LRU
+        order. Full-page hits only (the COW tail saves a copy, not a prefill skip)."""
+        page = self.page_size
+        pos = 0
+        cur = self.root
+        while pos + page <= len(prompt_ids):
+            child = cur.children.get(tuple(prompt_ids[pos : pos + page]))
+            if child is None:
+                break
+            cur = child
+            pos += page
+        return pos
+
     # ------------------------------------------------------------------ insertion
 
     def register(self, token_ids: list[int], page_ids: list[int], pool) -> int:

@@ -100,8 +100,10 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
     "model_report": ("param_groups", "totals", "hbm"),
     # continuous-batching serving engine (serving/engine.py): queue/slot/page state is
     # instantaneous, rates and counters are cumulative over the engine's lifetime
-    # (pages_* / page_fragmentation are null when the dense slot pool is in use)
+    # (pages_* / page_fragmentation are null when the dense slot pool is in use;
+    # replica_id identifies the engine within a router fleet, null standalone)
     "serving": (
+        "replica_id",
         "queue_depth",
         "slots_active",
         "num_slots",
@@ -119,6 +121,20 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         # active kernel backend per op family (ops/pallas/config.py) — which lowering
         # produced these serving numbers, for kernel A/B attribution
         "kernels",
+        "counters",
+    ),
+    # distributed serving router (serving/cluster/router.py): per-replica queue/slot
+    # state is instantaneous (list index == fleet position), routed/rejected/affinity
+    # counters are cumulative; handoff_latency_ms is the mean KV-handoff wall time over
+    # disaggregated replicas (null when no replica disaggregates)
+    "router": (
+        "replicas",
+        "queue_depths",
+        "slots_active",
+        "routed",
+        "rejected",
+        "prefix_affinity_hits",
+        "handoff_latency_ms",
         "counters",
     ),
 }
@@ -155,6 +171,14 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     # accept rate is accepted / proposed, rendered by tools/telemetry_summary.py
     "serving_draft_tokens_proposed",
     "serving_draft_tokens_accepted",
+    # distributed serving router (serving/cluster/router.py): requests placed on a
+    # replica / shed at the fleet-wide admission bound / routed by prefix affinity
+    "router_requests_routed",
+    "router_requests_rejected",
+    "router_prefix_affinity_hits",
+    # prefill/decode disaggregation (serving/cluster/disagg.py): KV page transfers from
+    # a prefill worker's pool into a decode worker's pool
+    "cluster_kv_handoffs",
 )
 
 KNOWN_EVENTS: tuple[str, ...] = (
@@ -184,6 +208,10 @@ KNOWN_GAUGES: tuple[str, ...] = (
     # accepted draft tokens per verify step (only written when speculation is enabled)
     "serving/accept_rate",
     "serving/accepted_tokens_per_step",
+    # distributed serving (serving/cluster/): fleet-wide waiting requests across all
+    # replicas, and the latest prefill->decode KV handoff wall time
+    "router/queue_depth",
+    "cluster/handoff_latency_ms",
 )
 
 # goodput buckets, in reporting order; "other" is the window remainder (python overhead,

@@ -1,0 +1,500 @@
+"""Distributed serving tier tests (serving/cluster/): TP-sharded replica parity,
+prefill/decode disaggregation with KV handoff, and the telemetry-driven router.
+
+Parity bars match the single-engine suites: TP=2 decode is asserted TOKEN-FOR-TOKEN
+(greedy bit-exact, sampled too) against the TP=1 engine with paged pool + prefix cache +
+chunked prefill all active, and the disaggregated prefill->decode path against the
+monolithic engine — both with `decode_compiles == 1`.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import (
+    DisaggregatedEngine,
+    EngineReplica,
+    KVHandoff,
+    QueueFullError,
+    RequestStatus,
+    Router,
+    SamplingParams,
+    ServingEngine,
+    inference_mesh,
+    make_sharded_engine,
+    route_batch,
+    serve_batch,
+)
+
+from .test_commons import get_dense_test_config
+
+PAGE = 16
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+def _engine_kwargs(config, **overrides):
+    kwargs = dict(
+        num_slots=2,
+        max_len=96,
+        prefill_bucket_multiple=8,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+        page_size=PAGE,
+        prefill_chunk_tokens=16,  # long prompts need >= 2 chunks: chunked path active
+    )
+    kwargs.update(overrides)
+    return kwargs
+
+
+def _mixed_workload(config, rs):
+    """Shared page-aligned prefix + unique tails (prefix cache engages), mixed greedy
+    and sampled rows, per-request rngs — the full paged+prefix+chunked regime."""
+    shared = _random_prompt(rs, config, 2 * PAGE)
+    prompts = [
+        shared + _random_prompt(rs, config, 5),
+        _random_prompt(rs, config, 41),
+        shared + _random_prompt(rs, config, 9),
+        _random_prompt(rs, config, 7),
+    ]
+    samplings = [
+        SamplingParams(),  # greedy: the bit-exact acceptance row
+        SamplingParams(do_sample=True, temperature=0.8),
+        SamplingParams(do_sample=True, temperature=1.2, top_k=7),
+        SamplingParams(do_sample=True, top_p=0.9),
+    ]
+    rngs = [jax.random.PRNGKey(100 + i) for i in range(len(prompts))]
+    return [
+        dict(prompt_ids=prompts[i], max_new_tokens=6, sampling=samplings[i], rng=rngs[i])
+        for i in range(len(prompts))
+    ]
+
+
+# ------------------------------------------------------------------- sharded replicas
+
+
+def test_tp2_engine_parity_token_for_token(eight_devices):
+    """TP=2 sharded engine (2-device mesh, params + KV heads sharded) decodes every
+    request token-for-token like the TP=1 engine — greedy asserted bit-exact, sampled
+    rows too — with paged pool, prefix hits, and chunked prefill active, and exactly
+    one compiled decode step."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(0)
+    specs = _mixed_workload(config, rs)
+
+    baseline = ServingEngine(model, params, **_engine_kwargs(config))
+    expected = [s.tokens for s in serve_batch(baseline, [dict(s) for s in specs])]
+
+    mesh = inference_mesh(tensor_parallel_size=2, devices=eight_devices[:2])
+    sharded = make_sharded_engine(model, params, mesh=mesh, **_engine_kwargs(config))
+    got = [s.tokens for s in serve_batch(sharded, [dict(s) for s in specs])]
+
+    assert got[0] == expected[0]  # greedy row: bit-exact across topologies
+    assert got == expected  # sampled rows follow the same rng stream
+    assert sharded.decode_compiles == 1  # sharding must not break compile-once
+    assert sharded.stats.prefix_hit_tokens > 0  # the shared prefix actually engaged
+
+    # the paged pool really is sharded: kv heads (dim 2) split over tp
+    spec = sharded.pool.caches[0]["k"].sharding.spec
+    assert tuple(spec) == (None, None, "tp")
+
+
+def test_sharded_pool_head_fallback(eight_devices):
+    """kv heads that don't divide tp fall back to replication instead of erroring
+    (the prune_indivisible escape hatch, serving-side)."""
+    from dolomite_engine_tpu.serving import PagedKVCachePool
+
+    config, model, _ = _tiny_model()  # gqa: 2 kv heads
+    mesh = inference_mesh(tensor_parallel_size=8, devices=eight_devices)
+    pool = PagedKVCachePool(model, 2, 64, PAGE, mesh=mesh)
+    assert tuple(pool.caches[0]["k"].sharding.spec) == ()  # 2 % 8 != 0 -> replicated
+
+
+def test_inference_mesh_validation(eight_devices):
+    with pytest.raises(ValueError):
+        inference_mesh(tensor_parallel_size=3, devices=eight_devices[:2])
+    mesh = inference_mesh(tensor_parallel_size=2, expert_parallel_size=2, devices=eight_devices[:4])
+    assert dict(zip(mesh.axis_names, mesh.devices.shape))["tp"] == 2
+    assert dict(zip(mesh.axis_names, mesh.devices.shape))["ep"] == 2
+
+
+def test_engine_mesh_requires_rules():
+    config, model, params = _tiny_model()
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, num_slots=1, max_len=32, mesh=object())
+
+
+# --------------------------------------------------------------------- disaggregation
+
+
+def _build_disagg(config, model, params, num_workers=2, clock=None, **prefill_overrides):
+    extra = {} if clock is None else {"clock": clock}
+    prefill = ServingEngine(
+        model, params, **_engine_kwargs(config, prefill_only=True, **extra, **prefill_overrides)
+    )
+    workers = [
+        ServingEngine(model, params, **_engine_kwargs(config, **extra))
+        for _ in range(num_workers)
+    ]
+    return DisaggregatedEngine(prefill, workers)
+
+
+def test_disaggregated_parity_token_for_token():
+    """Prefill worker -> KV handoff -> decode worker reproduces the monolithic engine
+    token-for-token on the same requests (greedy and sampled), and the handoff seam
+    actually transferred pages."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(1)
+    specs = _mixed_workload(config, rs)
+
+    mono = ServingEngine(model, params, **_engine_kwargs(config))
+    expected = [s.tokens for s in serve_batch(mono, [dict(s) for s in specs])]
+
+    disagg = _build_disagg(config, model, params)
+    states = [disagg.submit(**dict(s)) for s in specs]
+    disagg.drain()
+    assert [s.tokens for s in states] == expected
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert disagg.handoff.transfers == len(specs)
+    assert disagg.handoff.mean_latency_s > 0
+    for worker in disagg.workers:
+        assert worker.decode_compiles <= 1  # one idle worker may never compile
+    # prefill worker never decodes; decode workers never prefill
+    assert disagg.prefill.stats.decode_tokens == 0
+    assert all(w.stats.prefill_tokens == 0 for w in disagg.workers)
+    # every slot on both sides came back
+    assert disagg.prefill.pool.num_free == disagg.prefill.pool.num_slots
+    assert all(w.pool.num_free == w.pool.num_slots for w in disagg.workers)
+
+
+def test_kv_handoff_copies_page_bytes():
+    """The transferred pages hold byte-identical K/V in the destination pool."""
+    config, model, params = _tiny_model()
+    disagg = _build_disagg(config, model, params, num_workers=1)
+    rs = np.random.RandomState(2)
+    prompt = _random_prompt(rs, config, 2 * PAGE + 3)  # 3 pages: 2 full + tail
+
+    captured = {}
+    original_transfer = disagg.handoff.transfer
+
+    def capture(src_pool, src_pages, dst_pool, dst_pages):
+        captured["src"] = [np.asarray(src_pool.caches[0]["k"][p]) for p in src_pages]
+        captured["pages"] = (list(src_pages), list(dst_pages))
+        original_transfer(src_pool, src_pages, dst_pool, dst_pages)
+        captured["dst"] = [np.asarray(dst_pool.caches[0]["k"][p]) for p in dst_pages]
+
+    disagg.handoff.transfer = capture
+    state = disagg.submit(prompt_ids=prompt, max_new_tokens=4, rng=jax.random.PRNGKey(5))
+    disagg.drain()
+    assert state.status == RequestStatus.completed
+    src_pages, dst_pages = captured["pages"]
+    assert len(src_pages) == 3  # ceil(35 / 16)
+    for src, dst in zip(captured["src"], captured["dst"]):
+        np.testing.assert_array_equal(src, dst)
+
+
+def test_handoff_page_size_mismatch_rejected():
+    config, model, params = _tiny_model()
+    prefill = ServingEngine(model, params, **_engine_kwargs(config, prefill_only=True))
+    worker = ServingEngine(model, params, **_engine_kwargs(config, page_size=8))
+    with pytest.raises(ValueError):
+        DisaggregatedEngine(prefill, [worker])
+
+
+def test_prefill_only_contract():
+    config, model, params = _tiny_model()
+    with pytest.raises(ValueError):  # disaggregation is a paged-pool feature
+        ServingEngine(model, params, num_slots=1, max_len=32, paged=False, prefill_only=True)
+    with pytest.raises(ValueError):  # prefill workers never decode, so never speculate
+        ServingEngine(
+            model, params, num_slots=1, max_len=32, prefill_only=True, speculate_ngram=True
+        )
+
+    engine = ServingEngine(model, params, **_engine_kwargs(config, prefill_only=True))
+    rs = np.random.RandomState(3)
+    streamed = []
+    state = engine.submit(
+        prompt_ids=_random_prompt(rs, config, 20),
+        max_new_tokens=4,
+        on_token=streamed.append,
+    )
+    for _ in range(8):
+        engine.step()
+    # prefill finished: first token streamed, request parked (not decoded, not done)
+    assert state.tokens == streamed and len(streamed) == 1
+    assert engine.pending_handoffs == 1
+    assert not engine.has_work()  # parked work is the adopter's, not the stepper's
+    assert engine.stats.decode_tokens == 0
+
+
+def test_disagg_deadline_cancellation_spans_handoff():
+    """A deadline keeps binding after the request crosses the prefill->decode boundary:
+    both sides share the clock and the original submit time."""
+    config, model, params = _tiny_model()
+    now = [0.0]
+    disagg = _build_disagg(config, model, params, num_workers=1, clock=lambda: now[0])
+    rs = np.random.RandomState(4)
+    state = disagg.submit(
+        prompt_ids=_random_prompt(rs, config, 8), max_new_tokens=50, deadline_s=5.0
+    )
+    disagg.step()  # prefill + handoff + first decode steps
+    disagg.step()
+    assert state.status == RequestStatus.running and state.slot is not None
+    now[0] = 10.0  # deadline passes mid-decode, on the DECODE worker
+    disagg.drain()
+    assert state.status == RequestStatus.cancelled
+    assert disagg.workers[0].pool.num_free == disagg.workers[0].pool.num_slots
+
+
+# ---------------------------------------------------------------------------- router
+
+
+def test_router_least_loaded_and_rejection():
+    config, model, params = _tiny_model()
+    engines = [
+        ServingEngine(model, params, **_engine_kwargs(config, max_waiting=2))
+        for _ in range(2)
+    ]
+    router = Router([EngineReplica(i, e) for i, e in enumerate(engines)])
+    rs = np.random.RandomState(5)
+    # unique prompts (no affinity): submissions alternate by queue depth
+    for _ in range(4):
+        router.submit(prompt_ids=_random_prompt(rs, config, 9), max_new_tokens=2)
+    assert router.stats.per_replica_routed == {0: 2, 1: 2}
+    # both queues full (bound 2 each, nothing stepped): the fleet rejects
+    with pytest.raises(QueueFullError):
+        for _ in range(8):
+            router.submit(prompt_ids=_random_prompt(rs, config, 9), max_new_tokens=2)
+    assert router.stats.rejected == 1
+    router.drain()
+    assert sum(e.stats.completed for e in engines) == router.stats.routed
+
+
+def test_router_fcfs_and_deadline_through_router():
+    """Per replica, requests finish in submission order (FCFS is preserved through the
+    routing layer) and a lapsed deadline still cancels — waiting or mid-decode."""
+    config, model, params = _tiny_model()
+    now = [0.0]
+    engines = [
+        ServingEngine(
+            model, params, **_engine_kwargs(config, num_slots=1, clock=lambda: now[0])
+        )
+        for _ in range(2)
+    ]
+    replicas = [EngineReplica(i, e) for i, e in enumerate(engines)]
+    router = Router(replicas)
+    rs = np.random.RandomState(6)
+    finish_order: list[int] = []
+    states, homes = [], []
+    for i in range(6):
+        state = router.submit(
+            prompt_ids=_random_prompt(rs, config, 9),
+            max_new_tokens=3,
+            on_finish=lambda st, i=i: finish_order.append(i),
+        )
+        states.append(state)
+        homes.append(
+            next(r.replica_id for r in replicas if state in r.engine.scheduler.waiting)
+        )
+    doomed = router.submit(
+        prompt_ids=_random_prompt(rs, config, 9), max_new_tokens=3, deadline_s=1.0
+    )
+    now[0] = 5.0  # the deadline lapses while it waits behind a full replica
+    router.drain()
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert doomed.status == RequestStatus.cancelled
+    for replica_id in (0, 1):
+        mine = [i for i in range(6) if homes[i] == replica_id]
+        finished_mine = [i for i in finish_order if i in mine]
+        assert finished_mine == mine, f"replica {replica_id} broke FCFS"
+
+
+def test_router_prefix_affinity_and_replica_records(tmp_path):
+    """The e2e acceptance: all admitted requests complete over 2 replicas; serving
+    records carry each engine's replica_id; a repeated prompt routes to the replica
+    whose prefix cache holds its pages; the router record lands in the sink."""
+    from dolomite_engine_tpu.utils.telemetry import (
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _tiny_model()
+    sink = tmp_path / "router.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engines = [ServingEngine(model, params, **_engine_kwargs(config)) for _ in range(2)]
+        router = Router([EngineReplica(i, e) for i, e in enumerate(engines)])
+        rs = np.random.RandomState(7)
+        long_prompt = _random_prompt(rs, config, 2 * PAGE + 4)  # 2 full pages resident after
+        states = route_batch(
+            router,
+            [dict(prompt_ids=long_prompt, max_new_tokens=4, rng=jax.random.PRNGKey(9))]
+            + [
+                dict(prompt_ids=_random_prompt(rs, config, 9), max_new_tokens=4)
+                for _ in range(3)
+            ],
+        )
+        assert all(s.status == RequestStatus.completed for s in states)
+        home = next(i for i, e in enumerate(engines) if e.prefix_match_len(long_prompt) > 0)
+
+        # the repeat must land on the page-holding replica via affinity, and hit
+        again = router.submit(
+            prompt_ids=long_prompt, max_new_tokens=4, rng=jax.random.PRNGKey(9)
+        )
+        router.drain()
+        assert router.stats.affinity_hits == 1
+        assert again.tokens == states[0].tokens  # prefix reuse is still token-exact
+        assert engines[home].stats.prefix_hit_tokens > 0
+        assert engines[1 - home].stats.prefix_hit_tokens == 0
+    finally:
+        uninstall_telemetry()
+        telemetry.close()
+
+    records = [json.loads(line) for line in open(sink)]
+    servings = [r for r in records if r.get("kind") == "serving"]
+    assert {r["replica_id"] for r in servings} == {0, 1}
+    routers = [r for r in records if r.get("kind") == "router"]
+    assert routers, "router.drain must emit a router record"
+    last = routers[-1]
+    assert last["replicas"] == 2 and last["routed"] == 5
+    assert last["prefix_affinity_hits"] == 1
+    assert len(last["queue_depths"]) == 2
+
+
+def test_router_over_disaggregated_replicas():
+    """The router composes with disaggregation: replicas that are prefill+decode pairs,
+    with the handoff latency surfacing in the router record counters."""
+    config, model, params = _tiny_model()
+    replicas = [
+        EngineReplica(i, _build_disagg(config, model, params, num_workers=1))
+        for i in range(2)
+    ]
+    router = Router(replicas)
+    rs = np.random.RandomState(8)
+    states = route_batch(
+        router,
+        [
+            dict(prompt_ids=_random_prompt(rs, config, 9 + 4 * i), max_new_tokens=3)
+            for i in range(4)
+        ],
+    )
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert sum(r.engine.handoff.transfers for r in replicas) == 4
+    # replica ids were stamped on the underlying engines (prefill + workers)
+    assert replicas[0].engine.prefill.replica_id == 0
+    assert replicas[1].engine.workers[0].replica_id == 1
+
+
+def test_router_threaded_mode_drains():
+    """Threaded proof-of-concept: replicas step on background threads; the router only
+    submits and waits. Every request completes and the engines stay consistent."""
+    config, model, params = _tiny_model()
+    engines = [ServingEngine(model, params, **_engine_kwargs(config)) for _ in range(2)]
+    router = Router([EngineReplica(i, e) for i, e in enumerate(engines)])
+    rs = np.random.RandomState(9)
+    specs = [
+        dict(prompt_ids=_random_prompt(rs, config, 9 + 2 * i), max_new_tokens=3)
+        for i in range(4)
+    ]
+    router.start()
+    try:
+        states = [router.submit(**s) for s in specs]
+        assert router.wait(timeout_s=120.0), "threaded fleet failed to drain"
+    finally:
+        router.stop()
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert sum(e.stats.completed for e in engines) == 4
+    assert all(e.pool.num_free == e.pool.num_slots for e in engines)
+
+
+# ------------------------------------------------------------------------- generate.py
+
+
+def test_generate_cli_distributed_path(tmp_path, monkeypatch, eight_devices):
+    """generate.main with tensor_parallel_size=2 + replicas=2 + disaggregate: the full
+    distributed stack behind the dataset-generation entry point still writes the jsonl
+    in dataset order."""
+    from dolomite_engine_tpu import generate as generate_module
+    from dolomite_engine_tpu.arguments import InferenceArgs
+    from dolomite_engine_tpu.model_wrapper import base as mw_base
+    from dolomite_engine_tpu.parallel.mesh import MeshManager
+
+    class _StubTokenizer:
+        eos_token_id = 1
+        pad_token_id = 2
+        vocab_size = 2048
+
+        def __len__(self):
+            return self.vocab_size
+
+        def decode(self, ids, skip_special_tokens=True):
+            return " ".join(str(int(i)) for i in ids)
+
+    monkeypatch.setattr(
+        mw_base.ModelWrapper,
+        "_setup_tokenizer",
+        lambda self, name, extra: setattr(self, "tokenizer", _StubTokenizer()),
+    )
+    config = get_dense_test_config("mqa", "rope")
+    args = InferenceArgs(
+        model_args=dict(model_class="AutoModelForCausalLM", pretrained_config=config.to_dict()),
+        datasets=[
+            dict(
+                class_name="DebugDataset",
+                data_name="debug",
+                class_args=dict(num_examples=5, token_id=5),
+                max_input_tokens=6,
+                max_output_tokens=4,
+            )
+        ],
+        generation_parameters=dict(
+            batch_size=2,
+            max_new_tokens=3,
+            tensor_parallel_size=2,
+            replicas=2,
+            disaggregate=True,
+        ),
+        output_dir=str(tmp_path / "out"),
+    )
+    MeshManager.destroy()
+    try:
+        generate_module.main(args=args)
+    finally:
+        MeshManager.destroy()
+
+    lines = [json.loads(line) for line in open(tmp_path / "out" / "output-debug.jsonl")]
+    assert len(lines) == 5
+    assert all(0 <= line["num_generated_tokens"] <= 3 for line in lines)
+
+
+# ------------------------------------------------------------------------- arguments
+
+
+def test_generation_parameters_cluster_validation(eight_devices):
+    from dolomite_engine_tpu.arguments import GenerationParameters
+
+    base = dict(batch_size=2, max_new_tokens=4)
+    assert GenerationParameters(**base).replicas == 1
+    params = GenerationParameters(**base, tensor_parallel_size=2, replicas=3, disaggregate=True)
+    assert (params.tensor_parallel_size, params.replicas, params.disaggregate) == (2, 3, True)
+    with pytest.raises(ValueError):
+        GenerationParameters(**base, replicas=0)
+    with pytest.raises(ValueError):
+        GenerationParameters(**base, tensor_parallel_size=0)
+    with pytest.raises(ValueError):  # 8 virtual devices: 3 does not divide 8
+        GenerationParameters(**base, tensor_parallel_size=3)

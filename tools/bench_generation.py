@@ -50,6 +50,14 @@ def main() -> None:
         help="draft tokens per step for --speculate (K >= 1)",
     )
     p.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="A/B the telemetry-driven router over N engine replicas against 1 replica "
+        "at FIXED per-replica slots: aggregate decode tok/s + completed-requests/s "
+        "goodput; emits a BENCH-trajectory JSON line with router_goodput_ratio",
+    )
+    p.add_argument(
         "--seq2seq",
         action="store_true",
         help="bench enc_dec_dolomite decode instead: --prompt is the ENCODER length; the "
@@ -182,6 +190,8 @@ def main() -> None:
             )
         if args.speculate:
             record["speculate_ab"] = _bench_speculate_ab(model, params, config, args)
+        if args.replicas > 0:
+            record["router_ab"] = _bench_router_ab(model, params, config, args)
 
     print(json.dumps(record))
 
@@ -208,6 +218,21 @@ def main() -> None:
                     "value": round(ratio, 2),
                     "unit": "x dense slots at fixed KV HBM bytes",
                     "vs_baseline": round(ratio, 2),
+                }
+            )
+        )
+
+    if not args.seq2seq and args.replicas > 0:
+        ab = record["router_ab"]
+        print(
+            json.dumps(
+                {
+                    "metric": "router_goodput_ratio",
+                    "value": ab["goodput_ratio"],
+                    "unit": f"x 1-replica completed req/s at {args.batch} slots/replica",
+                    "vs_baseline": ab["goodput_ratio"],
+                    "replicas": args.replicas,
+                    "aggregate_decode_tok_s": ab["fleet"]["aggregate_decode_tok_s"],
                 }
             )
         )
@@ -349,6 +374,96 @@ def _bench_speculate_ab(model, params, config, args) -> dict:
         "accept_rate": round(stats.accept_rate() or 0.0, 4),
         "accepted_tokens_per_step": round(stats.accepted_tokens_per_step() or 0.0, 3),
         "verify_compiles": engine.verify_compiles,
+    }
+
+
+def _bench_router_ab(model, params, config, args) -> dict:
+    """Router fleet vs single replica at FIXED per-replica slots (`--batch` each).
+
+    The same mixed workload — a shared page-aligned prefix on half the requests (so
+    prefix-affinity routing has something to exploit) plus unique prompts — is driven
+    through (a) one engine and (b) N replicas behind the router, each round sized at
+    ``requests_per_slot * total slots``. Goodput is completed requests per second;
+    aggregate decode tok/s sums every replica's own accounting. On a single CPU host
+    the replicas time-share one device, so the ratio mostly measures router overhead —
+    the TPU fleet run is where N-replica scaling shows up; the JSON line exists to
+    track the trajectory either way."""
+    import numpy as np
+
+    from dolomite_engine_tpu.serving import EngineStats, ServingEngine
+    from dolomite_engine_tpu.serving.cluster import EngineReplica, Router, route_batch
+
+    backend_tpu = jax.default_backend() == "tpu"
+    multiple = 64 if backend_tpu else 16
+    page_size = 64 if backend_tpu else 16
+    max_len = -(-args.prompt // multiple) * multiple + args.new
+    rs = np.random.RandomState(11)
+    shared = list(map(int, rs.randint(3, config.vocab_size, 2 * page_size)))
+
+    def make_specs(count):
+        specs = []
+        for i in range(count):
+            if i % 2:
+                ids = shared + list(map(int, rs.randint(3, config.vocab_size, 8)))
+            else:
+                ids = list(map(int, rs.randint(3, config.vocab_size, args.prompt)))
+            specs.append(dict(prompt_ids=ids, max_new_tokens=args.new))
+        return specs
+
+    def build_fleet(n):
+        replicas = []
+        for replica_id in range(n):
+            engine = ServingEngine(
+                model,
+                params,
+                num_slots=args.batch,
+                max_len=max_len,
+                prefill_bucket_multiple=multiple,
+                max_waiting=8 * args.batch * max(n, 1),
+                eos_token_id=None,
+                pad_token_id=config.pad_token_id,
+                page_size=page_size,
+            )
+            replicas.append(EngineReplica(replica_id, engine))
+        return Router(replicas)
+
+    def run(n):
+        router = build_fleet(n)
+        requests = 2 * args.batch * n
+        route_batch(router, make_specs(requests))  # compile warmup
+        for replica in router.replicas:
+            replica.engine.stats = EngineStats()
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            route_batch(router, make_specs(requests))
+        wall = (time.perf_counter() - t0) / args.reps
+        completed = sum(r.engine.stats.completed for r in router.replicas) / args.reps
+        decode_tokens = sum(r.engine.stats.decode_tokens for r in router.replicas)
+        decode_seconds = sum(r.engine.stats.decode_seconds for r in router.replicas)
+        hit_rate = router.stats.affinity_hit_rate()
+        return {
+            "replicas": n,
+            "requests_per_round": requests,
+            "wall_s": round(wall, 4),
+            "goodput_req_s": round(completed / wall, 2),
+            "aggregate_decode_tok_s": round(
+                decode_tokens / max(decode_seconds, 1e-9), 1
+            ),
+            "prefix_affinity_hit_rate": None if hit_rate is None else round(hit_rate, 3),
+            "per_replica_routed": {
+                str(k): v for k, v in sorted(router.stats.per_replica_routed.items())
+            },
+        }
+
+    baseline = run(1)
+    fleet = run(args.replicas)
+    return {
+        "slots_per_replica": args.batch,
+        "baseline": baseline,
+        "fleet": fleet,
+        "goodput_ratio": round(
+            fleet["goodput_req_s"] / max(baseline["goodput_req_s"], 1e-9), 3
+        ),
     }
 
 

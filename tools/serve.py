@@ -80,6 +80,26 @@ def parse_args() -> argparse.Namespace:
         default=4,
         help="draft tokens proposed per engine step (K >= 1)",
     )
+    p.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor-parallel size per engine replica (must divide the device count); "
+        "the engine's jits run over a TP mesh with params and KV heads sharded",
+    )
+    p.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="engine replicas behind the telemetry-driven router "
+        "(serving/cluster/router.py): prefix-affinity + least-loaded selection",
+    )
+    p.add_argument(
+        "--disaggregate",
+        action="store_true",
+        help="split each replica into a prefill worker and a decode worker with an "
+        "explicit KV page handoff (serving/cluster/disagg.py)",
+    )
     p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
     p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
     p.add_argument("--seed", type=int, default=0)
@@ -125,11 +145,19 @@ def main() -> None:
     from dolomite_engine_tpu.serving import SamplingParams, ServingEngine, serve_batch
     from dolomite_engine_tpu.utils.telemetry import Telemetry, install_telemetry
 
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    if args.tp < 1 or jax.device_count() % args.tp != 0:
+        raise SystemExit(
+            f"--tp {args.tp} must be >= 1 and divide the device count ({jax.device_count()})"
+        )
     if not MeshManager.is_initialized():
-        MeshManager()
+        MeshManager(tensor_parallel_size=args.tp)
     model = ModelWrapperForFinetuning(mode=Mode.inference, model_name=args.model)
     params = model.load_pretrained_params(args.model, MeshManager.get_mesh())
     assert model.tokenizer is not None, "serving requires a tokenizer"
+    mesh = MeshManager.get_mesh() if args.tp > 1 else None
+    rules = model.sharding_rules() if args.tp > 1 else None
 
     telemetry = None
     if args.telemetry_sink:
@@ -158,27 +186,58 @@ def main() -> None:
     pad_token_id = next(
         (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
     )
-    engine = ServingEngine(
-        model.model,
-        params,
-        num_slots=args.num_slots,
-        max_len=max_len,
-        prefill_bucket_multiple=multiple,
-        max_waiting=args.max_waiting,
-        eos_token_id=model.eos_token_id,
-        pad_token_id=pad_token_id,
-        rng=jax.random.PRNGKey(args.seed),
-        record_interval=100,
-        paged=not args.dense_kv,
-        page_size=args.page_size,
-        num_pages=args.num_pages,
-        prefill_chunk_tokens=args.prefill_chunk_tokens,
-        prefix_caching=not args.no_prefix_cache,
-        speculate_ngram=args.speculate_ngram,
-        draft_model=draft_model,
-        draft_params=draft_params,
-        draft_k=args.draft_k,
-    )
+
+    def build_engine(**overrides):
+        kwargs = dict(
+            num_slots=args.num_slots,
+            max_len=max_len,
+            prefill_bucket_multiple=multiple,
+            max_waiting=args.max_waiting,
+            eos_token_id=model.eos_token_id,
+            pad_token_id=pad_token_id,
+            rng=jax.random.PRNGKey(args.seed),
+            record_interval=100,
+            paged=not args.dense_kv,
+            page_size=args.page_size,
+            num_pages=args.num_pages,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            prefix_caching=not args.no_prefix_cache,
+            speculate_ngram=args.speculate_ngram,
+            draft_model=draft_model,
+            draft_params=draft_params,
+            draft_k=args.draft_k,
+            mesh=mesh,
+            sharding_rules=rules,
+        )
+        kwargs.update(overrides)
+        return ServingEngine(model.model, params, **kwargs)
+
+    router = None
+    if args.replicas > 1 or args.disaggregate:
+        from dolomite_engine_tpu.serving.cluster import (
+            DisaggregatedEngine,
+            EngineReplica,
+            Router,
+        )
+
+        if args.disaggregate and args.dense_kv:
+            raise SystemExit("--disaggregate requires the paged KV pool (drop --dense-kv)")
+        replicas = []
+        for replica_id in range(args.replicas):
+            if args.disaggregate:
+                prefill = build_engine(
+                    prefill_only=True,
+                    speculate_ngram=False,
+                    draft_model=None,
+                    draft_params=None,
+                )
+                replica_engine = DisaggregatedEngine(prefill, [build_engine()])
+            else:
+                replica_engine = build_engine()
+            replicas.append(EngineReplica(replica_id, replica_engine))
+        router = Router(replicas, record_interval=100)
+    else:
+        engine = build_engine()
 
     sampling = SamplingParams(
         do_sample=args.do_sample,
@@ -195,7 +254,12 @@ def main() -> None:
         )
         for ids in prompt_ids
     ]
-    states = serve_batch(engine, specs)
+    if router is not None:
+        from dolomite_engine_tpu.serving.cluster import route_batch
+
+        states = route_batch(router, specs)
+    else:
+        states = serve_batch(engine, specs)
 
     out = open(args.output, "w") if args.output else sys.stdout
     try:
@@ -222,6 +286,36 @@ def main() -> None:
 
     if telemetry is not None:
         telemetry.close()
+
+    if router is not None:
+        from dolomite_engine_tpu.serving.cluster import DisaggregatedEngine
+
+        completed = sum(1 for s in states if str(s.status) == "completed")
+        cancelled = sum(1 for s in states if str(s.status) == "cancelled")
+        hit_rate = router.stats.affinity_hit_rate()
+        handoffs = [
+            r.engine.handoff for r in router.replicas
+            if isinstance(r.engine, DisaggregatedEngine)
+        ]
+        transfers = sum(h.transfers for h in handoffs)
+        handoff_info = ""
+        if handoffs:
+            mean_ms = (
+                1e3 * sum(h.mean_latency_s * h.transfers for h in handoffs) / transfers
+                if transfers
+                else 0.0
+            )
+            handoff_info = f", kv handoffs={transfers} (mean {mean_ms:.1f}ms)"
+        print(
+            f"router: {router.stats.routed} routed / {router.stats.rejected} rejected "
+            f"over {len(router.replicas)} replica(s), admissions per replica "
+            f"{dict(sorted(router.stats.per_replica_routed.items()))}, "
+            f"prefix-affinity hit rate "
+            f"{'n/a' if hit_rate is None else f'{hit_rate:.1%}'}"
+            f"{handoff_info}; {completed} completed, {cancelled} cancelled",
+            file=sys.stderr,
+        )
+        return
 
     stats = engine.stats
     ttft = stats.mean_ttft_s()

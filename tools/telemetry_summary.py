@@ -150,6 +150,7 @@ def summarize(records: list[dict]) -> str:
     healths = [r for r in records if r.get("kind") == "health"]
     model_reports = [r for r in records if r.get("kind") == "model_report"]
     servings = [r for r in records if r.get("kind") == "serving"]
+    routers = [r for r in records if r.get("kind") == "router"]
 
     lines: list[str] = []
 
@@ -279,6 +280,40 @@ def summarize(records: list[dict]) -> str:
             if last.get("page_fragmentation") is not None:
                 page_line += f" (frag {100.0 * last['page_fragmentation']:.1f}%)"
             parts.append(page_line)
+        replica_ids = sorted(
+            {r["replica_id"] for r in servings if r.get("replica_id") is not None}
+        )
+        if replica_ids:
+            parts.append(f"replicas seen {replica_ids}")
+        lines.append(", ".join(parts))
+        lines.append("")
+
+    # ---------------------------------------------------------------- router
+    if routers:
+        last = routers[-1]  # routed/rejected/affinity are cumulative
+        counters = last.get("counters") or {}
+        parts = [
+            f"router: {last.get('routed', 0)} routed / {last.get('rejected', 0)} rejected "
+            f"over {last.get('replicas', '?')} replica(s)"
+        ]
+        hits = last.get("prefix_affinity_hits", 0)
+        routed = last.get("routed", 0)
+        if routed:
+            parts.append(
+                f"prefix-affinity hits {hits} ({100.0 * hits / routed:.1f}% of routed)"
+            )
+        per_replica = counters.get("per_replica_routed") or {}
+        if per_replica:
+            parts.append(
+                "per-replica " + ", ".join(f"#{k}:{v}" for k, v in sorted(per_replica.items()))
+            )
+        if last.get("queue_depths"):
+            parts.append(f"queue depths {last['queue_depths']}")
+        if last.get("handoff_latency_ms") is not None:
+            parts.append(
+                f"kv handoff {counters.get('kv_handoffs', '?')} transfers "
+                f"(mean {last['handoff_latency_ms']:.1f}ms)"
+            )
         lines.append(", ".join(parts))
         lines.append("")
 
@@ -341,7 +376,9 @@ def summarize(records: list[dict]) -> str:
         )
         lines.append("")
 
-    if not (steps or windows or events or run_starts or healths or model_reports or servings):
+    if not (
+        steps or windows or events or run_starts or healths or model_reports or servings or routers
+    ):
         lines.append("(no telemetry records found)")
     return "\n".join(lines).rstrip() + "\n"
 

@@ -1,8 +1,11 @@
-"""Sharded (tensor-parallel) generation demo.
+"""Sharded (tensor-parallel) generation demo — through the real serving path.
 
 Parity: reference `tools/tensor_parallel_inference.py:10-22` — NCCL init +
-`GPTDolomiteForCausalLM_TP.from_pretrained` + generate. Under GSPMD there is no `_TP` class:
-the same model runs tensor-parallel by loading params with TP shardings over the mesh.
+`GPTDolomiteForCausalLM_TP.from_pretrained` + generate. Under GSPMD there is no `_TP`
+class: the same model runs tensor-parallel by loading params with TP shardings over the
+mesh. The demo drives the TP-sharded `ServingEngine` (serving/cluster/sharded.py) — the
+same jitted chunked-prefill + paged-decode programs production serving runs, with the KV
+pool sharded along kv heads — instead of the legacy one-shot `model.generate` loop.
 
 Run (virtual 8-device CPU example):
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -30,6 +33,7 @@ def main() -> None:
     from dolomite_engine_tpu.enums import Mode
     from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning
     from dolomite_engine_tpu.parallel.mesh import MeshManager
+    from dolomite_engine_tpu.serving import ServingEngine, serve_batch
 
     tp = args.tp or jax.device_count()
     MeshManager(tensor_parallel_size=tp)
@@ -43,18 +47,40 @@ def main() -> None:
     # TP-sharded from birth: every parameter is placed per the tp sharding rules, never
     # materialized whole on one device (the GSPMD analogue of per-rank sharded loading)
     params = model.load_pretrained_params(args.model, mesh)
+    assert model.tokenizer is not None, "serving requires a tokenizer"
 
-    x = model.tokenizer(args.prompt, return_tensors="np")
-    batch = {
-        "input_ids": x["input_ids"].astype("int32"),
-        "attention_mask": x["attention_mask"].astype("int32"),
-    }
-    with mesh:
-        texts, counts = model.generate(
-            params, batch, {"max_new_tokens": args.max_new_tokens}
-        )
-    print(f"[tp={tp}] generated {counts[0]} tokens:")
-    print(args.prompt + texts[0])
+    prompt_ids = model.tokenizer(args.prompt, add_special_tokens=False)["input_ids"]
+    multiple = 8
+    max_len = -(-len(prompt_ids) // multiple) * multiple + args.max_new_tokens
+    pad_token_id = next(
+        (t for t in (model.tokenizer.pad_token_id, model.eos_token_id) if t is not None), 0
+    )
+    engine = ServingEngine(
+        model.model,
+        params,
+        num_slots=1,
+        max_len=max_len,
+        prefill_bucket_multiple=multiple,
+        eos_token_id=model.eos_token_id,
+        pad_token_id=pad_token_id,
+        mesh=mesh,
+        sharding_rules=model.sharding_rules(),
+    )
+    state = serve_batch(
+        engine, [dict(prompt_ids=prompt_ids, max_new_tokens=args.max_new_tokens)]
+    )[0]
+
+    text = model.tokenizer.decode(state.tokens, skip_special_tokens=True)
+    print(f"[tp={tp}] generated {state.num_generated} tokens:")
+    print(args.prompt + text)
+    stats = engine.stats
+    decode_rate = stats.decode_tok_s()
+    print(
+        f"engine: decode compiles={engine.decode_compiles}, "
+        f"ttft={'n/a' if state.ttft_s is None else f'{state.ttft_s * 1e3:.0f}ms'}, "
+        f"decode={'n/a' if decode_rate is None else f'{decode_rate:.0f}'} tok/s",
+        file=sys.stderr,
+    )
 
 
 if __name__ == "__main__":
