@@ -623,6 +623,11 @@ class KernelArgs(BaseArgs):
     splash_attention: KernelBackend = KernelBackend.xla
     # serving decode/verify attention straight off the paged KV pool's page table
     paged_attention: KernelBackend = KernelBackend.xla
+    # chunked-prefill flash attention through the page table (online softmax) — the
+    # serving engine's prefill chunks skip the worst-case gathered view
+    prefill_attention: KernelBackend = KernelBackend.xla
+    # per-page KV quantization encode (int8/fp8 paged pools' quantize-on-scatter)
+    paged_kv_quant: KernelBackend = KernelBackend.xla
     # fused RMSNorm(+residual add) inside the transformer block
     rmsnorm: KernelBackend = KernelBackend.xla
     # grouped-GEMM MoE dispatch (sort-by-expert segment GEMMs) for the dense + EP paths
@@ -637,6 +642,8 @@ class KernelArgs(BaseArgs):
             {
                 "splash_attention": self.splash_attention,
                 "paged_attention": self.paged_attention,
+                "prefill_attention": self.prefill_attention,
+                "paged_kv_quant": self.paged_kv_quant,
                 "rmsnorm": self.rmsnorm,
                 "moe_dispatch": self.moe_dispatch,
             }
@@ -721,6 +728,11 @@ class GenerationParameters(BaseArgs):
     # per-engine-step prefill token budget (chunked prefill): long prompts are computed
     # in chunks interleaved with decode steps; positive multiple of 8
     prefill_chunk_tokens: int = 512
+    # paged-pool page storage format (serving/kv_cache.KV_DTYPES): "bf16" halves page
+    # bytes vs fp32; "int8"/"fp8" store quantized pages + per-(page, kv-head) fp32
+    # scales — ~2x sustainable slots again at fixed KV HBM, tolerance-level accuracy.
+    # None keeps the model/cache dtype
+    kv_dtype: str | None = None
     # share page-aligned resident prompt prefixes across requests (RadixAttention-style)
     prefix_caching: bool = True
     # ---- speculative decoding (serving/engine.py, docs/SERVING.md) ----
@@ -770,6 +782,15 @@ class GenerationParameters(BaseArgs):
                 f"kv_num_pages must be >= 2 (page 0 is the trash page), got "
                 f"{self.kv_num_pages}"
             )
+        if self.kv_dtype is not None:
+            from .serving.kv_cache import KV_DTYPES
+
+            if self.kv_dtype not in KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype must be one of {sorted(KV_DTYPES)}, got {self.kv_dtype!r}"
+                )
+            if not self.paged_kv_cache:
+                raise ValueError("kv_dtype requires paged_kv_cache=True")
         if self.draft_k < 1:
             raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
         if self.speculate_ngram and self.draft_model is not None:

@@ -46,6 +46,7 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
         "paged_kv_cache",
         "kv_page_size",
         "kv_num_pages",
+        "kv_dtype",
         "prefill_chunk_tokens",
         "prefix_caching",
         "speculate_ngram",
@@ -158,6 +159,7 @@ def _generate_with_engine(
             paged=gp.paged_kv_cache,
             page_size=gp.kv_page_size,
             num_pages=gp.kv_num_pages,
+            kv_dtype=gp.kv_dtype,
             prefill_chunk_tokens=gp.prefill_chunk_tokens,
             prefix_caching=gp.prefix_caching,
             speculate_ngram=gp.speculate_ngram,

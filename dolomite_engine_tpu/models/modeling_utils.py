@@ -297,8 +297,18 @@ def _update_paged_kv_cache(
     Write validity comes from `attention_mask` (key-side over the gathered view length):
     a chunked-prefill bucket's right-pad tail maps to mask-0 positions, and those writes
     are redirected to the trash page instead of corrupting a real (or unallocated) page.
+
+    A cache additionally carrying ``k_scale``/``v_scale`` pools is a QUANTIZED paged pool
+    (``kv_dtype="int8"|"fp8"``): the scatter quantizes on write
+    (`ops/attention.paged_scatter_kv_quantized`) and the gather dequantizes the view back
+    to the activation dtype — attention downstream is unchanged either way.
     """
-    from ..ops.attention import paged_gather_kv, paged_scatter_kv
+    from ..ops.attention import (
+        paged_gather_kv,
+        paged_gather_kv_dequant,
+        paged_scatter_kv,
+        paged_scatter_kv_quantized,
+    )
 
     table = kv_cache["page_table"]  # [B, max_pages]
     page_size = kv_cache["k"].shape[1]
@@ -328,10 +338,30 @@ def _update_paged_kv_cache(
             attention_mask.astype(bool), positions, axis=1
         )
 
-    k_pages = paged_scatter_kv(kv_cache["k"], key, table, positions, write_valid)
-    v_pages = paged_scatter_kv(kv_cache["v"], value, table, positions, write_valid)
-    k_view = paged_gather_kv(k_pages, table)
-    v_view = paged_gather_kv(v_pages, table)
+    if "k_scale" in kv_cache:
+        k_pages, k_scales = paged_scatter_kv_quantized(
+            kv_cache["k"], kv_cache["k_scale"], key, table, positions, write_valid
+        )
+        v_pages, v_scales = paged_scatter_kv_quantized(
+            kv_cache["v"], kv_cache["v_scale"], value, table, positions, write_valid
+        )
+        k_view = paged_gather_kv_dequant(k_pages, k_scales, table, key.dtype)
+        v_view = paged_gather_kv_dequant(v_pages, v_scales, table, value.dtype)
+        kv_cache = {
+            "k": k_pages,
+            "v": v_pages,
+            "k_scale": k_scales,
+            "v_scale": v_scales,
+            "page_table": table,
+        }
+    else:
+        k_pages = paged_scatter_kv(kv_cache["k"], key, table, positions, write_valid)
+        v_pages = paged_scatter_kv(kv_cache["v"], value, table, positions, write_valid)
+        # a low-bit (but unquantized) pool — kv_dtype="bf16" under an fp32 model —
+        # gathers in the pool dtype; attention runs in the activation dtype
+        k_view = paged_gather_kv(k_pages, table).astype(key.dtype)
+        v_view = paged_gather_kv(v_pages, table).astype(value.dtype)
+        kv_cache = {"k": k_pages, "v": v_pages, "page_table": table}
 
     valid = jnp.arange(view_len)[None, :] < frontier
     attention_mask = (
@@ -339,7 +369,6 @@ def _update_paged_kv_cache(
         if attention_mask is None
         else attention_mask * valid.astype(attention_mask.dtype)
     )
-    kv_cache = {"k": k_pages, "v": v_pages, "page_table": table}
     return k_view, v_view, kv_cache, attention_mask, cache_index
 
 
@@ -382,8 +411,10 @@ def _paged_pallas_attention(
     their pages exactly like `_update_paged_kv_cache` (bit-identical pool state), then
     let the ragged kernel (`ops/pallas/paged_attention.py`) read K/V through the table —
     no ``[B, max_pages * page_size]`` gathered view, traffic scales with each row's
-    resident tokens instead of the worst case."""
-    from ..ops.attention import paged_scatter_kv
+    resident tokens instead of the worst case. Quantized pools (``k_scale`` present)
+    share the quantize-on-scatter with the XLA path and hand the scale pools to the
+    kernel, which dequantizes inside its per-page DMA loop."""
+    from ..ops.attention import paged_scatter_kv, paged_scatter_kv_quantized
     from ..ops.pallas.paged_attention import paged_decode_attention
 
     table = kv_cache["page_table"]
@@ -396,12 +427,125 @@ def _paged_pallas_attention(
     ).astype(jnp.int32)
     in_range = positions < view_len
     positions = jnp.where(in_range, positions, 0)
+    if "k_scale" in kv_cache:
+        k_pages, k_scales = paged_scatter_kv_quantized(
+            kv_cache["k"], kv_cache["k_scale"], key, table, positions, in_range
+        )
+        v_pages, v_scales = paged_scatter_kv_quantized(
+            kv_cache["v"], kv_cache["v_scale"], value, table, positions, in_range
+        )
+        out = paged_decode_attention(
+            query, k_pages, v_pages, table, cache_index, softmax_scale,
+            k_scales=k_scales, v_scales=v_scales,
+        )
+        return out, {
+            "k": k_pages,
+            "v": v_pages,
+            "k_scale": k_scales,
+            "v_scale": v_scales,
+            "page_table": table,
+        }
     k_pages = paged_scatter_kv(kv_cache["k"], key, table, positions, in_range)
     v_pages = paged_scatter_kv(kv_cache["v"], value, table, positions, in_range)
 
     out = paged_decode_attention(
         query, k_pages, v_pages, table, cache_index, softmax_scale
     )
+    return out, {"k": k_pages, "v": v_pages, "page_table": table}
+
+
+def _paged_prefill_eligible(
+    kv_cache: KVCache | None,
+    cache_index,
+    attention_mask,
+    segment_ids,
+    alibi_bias,
+    causal: bool,
+    dropout: float,
+    seq: int,
+) -> bool:
+    """Whether this attention call is the serving engine's chunked-prefill program shape
+    the flash prefill kernel handles: paged cache, multi-token query window, one shared
+    (scalar) chunk write offset, and the chunk jit's key-side prefix mask over the
+    gathered view (1s exactly on ``[0, start + num_real)``; `serving/engine._get_chunk_fn`
+    builds it that way). Under that contract the kernel's per-row causal frontier at
+    ``start + row`` reproduces the masked reference for every REAL chunk row — pad tail
+    rows attend walked-page garbage, but their outputs (and their trash-redirected K/V
+    writes) are never read. Dense caches, decode/verify ([B] frontier vectors — the
+    decode kernel's shape), and training stay off this path."""
+    scalar_index = cache_index is not None and (
+        isinstance(cache_index, int) or getattr(cache_index, "ndim", None) == 0
+    )
+    return (
+        kv_cache is not None
+        and "page_table" in kv_cache
+        and seq > 1
+        and scalar_index
+        and attention_mask is not None
+        and getattr(attention_mask, "ndim", 0) == 2
+        and segment_ids is None
+        and alibi_bias is None
+        and causal
+        and dropout == 0.0
+        and use_pallas("prefill_attention")
+    )
+
+
+def _paged_prefill_pallas_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    kv_cache: KVCache,
+    cache_index,
+    attention_mask: jax.Array,
+    softmax_scale: float,
+) -> tuple[jax.Array, KVCache]:
+    """Chunked-prefill attention through the page table: scatter the chunk's K/V exactly
+    like `_update_paged_kv_cache` (same position clamping and mask-derived write
+    validity, so pool state is bit-identical to the XLA path), then the flash kernel
+    (`ops/pallas/prefill_attention.py`) walks only the pages under the chunk's causal
+    frontier — the ``[B, max_pages * page_size]`` worst-case gathered view is never
+    built for prefill anymore."""
+    from ..ops.attention import paged_scatter_kv, paged_scatter_kv_quantized
+    from ..ops.pallas.prefill_attention import paged_prefill_attention
+
+    table = kv_cache["page_table"]
+    page_size = kv_cache["k"].shape[1]
+    batch, seq = key.shape[:2]
+    view_len = table.shape[1] * page_size
+
+    start = jnp.asarray(cache_index, jnp.int32)  # scalar chunk write offset
+    positions = jnp.broadcast_to(
+        (start + jnp.arange(seq, dtype=jnp.int32))[None, :], (batch, seq)
+    )
+    in_range = positions < view_len
+    positions = jnp.where(in_range, positions, 0)
+    write_valid = in_range & jnp.take_along_axis(
+        attention_mask.astype(bool), positions, axis=1
+    )
+    starts = jnp.broadcast_to(start, (batch,))
+
+    if "k_scale" in kv_cache:
+        k_pages, k_scales = paged_scatter_kv_quantized(
+            kv_cache["k"], kv_cache["k_scale"], key, table, positions, write_valid
+        )
+        v_pages, v_scales = paged_scatter_kv_quantized(
+            kv_cache["v"], kv_cache["v_scale"], value, table, positions, write_valid
+        )
+        out = paged_prefill_attention(
+            query, k_pages, v_pages, table, starts, softmax_scale,
+            k_scales=k_scales, v_scales=v_scales,
+        )
+        return out, {
+            "k": k_pages,
+            "v": v_pages,
+            "k_scale": k_scales,
+            "v_scale": v_scales,
+            "page_table": table,
+        }
+    k_pages = paged_scatter_kv(kv_cache["k"], key, table, positions, write_valid)
+    v_pages = paged_scatter_kv(kv_cache["v"], value, table, positions, write_valid)
+    out = paged_prefill_attention(query, k_pages, v_pages, table, starts, softmax_scale)
     return out, {"k": k_pages, "v": v_pages, "page_table": table}
 
 
@@ -486,6 +630,18 @@ class Attention(nn.Module):
             ):
                 out, kv_cache = _paged_pallas_attention(
                     query, key, value, kv_cache, cache_index, softmax_scale
+                )
+                out = out.reshape(batch, seq, num_heads * head_dim)
+                out = c_proj(out)
+                out = nn.Dropout(rate=config.resid_pdrop)(out, deterministic=deterministic)
+                return out, kv_cache
+            if config.attention_softmax_in_fp32 and _paged_prefill_eligible(
+                kv_cache, cache_index, attention_mask, segment_ids, alibi_bias,
+                self.causal, attn_pdrop, seq,
+            ):
+                out, kv_cache = _paged_prefill_pallas_attention(
+                    query, key, value, kv_cache, cache_index, attention_mask,
+                    softmax_scale,
                 )
                 out = out.reshape(batch, seq, num_heads * head_dim)
                 out = c_proj(out)

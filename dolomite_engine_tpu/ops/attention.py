@@ -99,7 +99,9 @@ def paged_scatter_kv(
         flat_index = jnp.where(write_valid, flat_index, 0)
     flat_pages = pages.reshape((num_pages * page_size,) + pages.shape[2:])
     flat_pages = flat_pages.at[flat_index.reshape(-1)].set(
-        new.reshape((batch * seq,) + new.shape[2:])
+        # explicit cast: a low-bit pool (kv_dtype="bf16" under an fp32 model) stores
+        # rounded tokens; a matching dtype is a no-op
+        new.reshape((batch * seq,) + new.shape[2:]).astype(pages.dtype)
     )
     # keep the pool kv-head-sharded through the scatter (serving/kv_cache.shard_kv_caches
     # places it that way): without the pin GSPMD may emit a replicated output, which both
@@ -108,6 +110,106 @@ def paged_scatter_kv(
     return logical_constraint(
         flat_pages.reshape(pages.shape), (None, None, "act_kv_heads", None)
     )
+
+
+def paged_scatter_kv_quantized(
+    pages: jax.Array,
+    scales: jax.Array,
+    new: jax.Array,
+    page_table: jax.Array,
+    positions: jax.Array,
+    write_valid: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Quantize-on-scatter into a low-bit paged pool (``serving/kv_cache``
+    ``kv_dtype="int8"|"fp8"``).
+
+    ``pages`` holds quantized values ``[num_pages, page_size, H, D]`` with per-(page,
+    head) fp32 ``scales`` ``[num_pages, H]``. Each row's write window ``positions``
+    (always contiguous: ``cache_index + arange(S)``) touches a bounded run of logical
+    pages; those pages are gathered, dequantized, the new tokens inserted, and the whole
+    page re-encoded (`ops/kv_quant.quantize_pages`) with a fresh absmax over its VALID
+    tokens — committed prefix plus this call's actual writes, never the stale garbage
+    beyond the frontier. While a page's scale is unchanged the re-encode is exact (see
+    `ops/kv_quant`), so repeated decode writes do not drift committed tokens.
+
+    Trash-page discipline matches `paged_scatter_kv`: invalid writes (pad tails,
+    overhang) are dropped from the insert, and window pages with no actual write —
+    including the one-page look-ahead pad of the window bound — are redirected to page
+    0, so a mapped page is only ever rewritten by the row that owns it (writable pages
+    are refcount-1 private by the pool contract; shared prefix pages are never inside a
+    write window).
+    """
+    from ..parallel.sharding import logical_constraint
+    from .kv_quant import kv_qmax, quantize_pages
+
+    num_pages, page_size = pages.shape[:2]
+    heads, head_dim = pages.shape[2:]
+    batch, seq = positions.shape
+    max_pages = page_table.shape[1]
+    # a contiguous S-token window spans at most this many logical pages (the +1 pads
+    # the bound when the window straddles a page boundary)
+    span = (seq - 1) // page_size + 2
+    base = positions[:, 0] // page_size  # [B] — positions[:, 0] is the row's frontier
+    logical = base[:, None] + jnp.arange(span, dtype=positions.dtype)[None, :]
+    in_table = logical < max_pages
+    phys = jnp.where(
+        in_table,
+        jnp.take_along_axis(page_table, jnp.clip(logical, 0, max_pages - 1), axis=1),
+        0,
+    )  # [B, span]
+
+    # dequantize the touched window, insert the new tokens (invalid writes dropped)
+    window = pages[phys].astype(jnp.float32) * scales[phys][:, :, None, :, None]
+    window = window.reshape(batch, span * page_size, heads, head_dim)
+    local = positions - base[:, None] * page_size
+    local = jnp.where(write_valid, local, span * page_size)  # out of bounds -> dropped
+    rows = jnp.arange(batch)[:, None]
+    window = window.at[rows, local].set(new.astype(jnp.float32), mode="drop")
+    written = jnp.zeros((batch, span * page_size), bool).at[rows, local].set(
+        True, mode="drop"
+    )
+    grid = base[:, None] * page_size + jnp.arange(span * page_size)
+    valid = (grid < positions[:, :1]) | written  # committed prefix + this call's writes
+
+    q, new_scales = quantize_pages(
+        window.reshape(batch * span, page_size, heads, head_dim),
+        valid.reshape(batch * span, page_size),
+        kv_qmax(pages.dtype),
+        pages.dtype,
+    )
+    # only pages that actually received a write go back (untouched window pad -> trash,
+    # where colliding garbage is harmless by the trash-page contract)
+    page_written = written.reshape(batch, span, page_size).any(-1)
+    dst = jnp.where(page_written & in_table, phys, 0).reshape(-1)
+    pages = pages.at[dst].set(q)
+    scales = scales.at[dst].set(new_scales)
+    # same sharding pins as paged_scatter_kv: keep pool and scale pool kv-head-sharded
+    # through the scatter so the donated decode buffers keep a stable sharding
+    pages = logical_constraint(pages, (None, None, "act_kv_heads", None))
+    scales = logical_constraint(scales, (None, "act_kv_heads"))
+    return pages, scales
+
+
+def paged_gather_kv_dequant(
+    pages: jax.Array, scales: jax.Array, page_table: jax.Array, dtype
+) -> jax.Array:
+    """Dequantizing variant of `paged_gather_kv` for the XLA reference attention paths:
+    gather each row's quantized pages into a contiguous ``[B, max_pages * page_size, H,
+    D]`` view in ``dtype``, applying each page's per-head scale. Positions past a row's
+    validity frontier decode stale-but-finite garbage the attention mask zeroes, exactly
+    like the unquantized gather."""
+    from ..parallel.sharding import logical_constraint
+
+    num_pages, page_size = pages.shape[:2]
+    batch, max_pages = page_table.shape
+    flat_pages = pages.reshape((num_pages * page_size,) + pages.shape[2:])
+    index = (
+        page_table[:, :, None] * page_size + jnp.arange(page_size, dtype=page_table.dtype)
+    ).reshape(batch, max_pages * page_size)
+    values = flat_pages[index].astype(jnp.float32)
+    page_scales = jnp.repeat(scales[page_table], page_size, axis=1)  # [B, view, H]
+    out = (values * page_scales[..., None]).astype(dtype)
+    return logical_constraint(out, (None, None, "act_kv_heads", None))
 
 
 def paged_gather_kv(pages: jax.Array, page_table: jax.Array) -> jax.Array:

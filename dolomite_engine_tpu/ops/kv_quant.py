@@ -1,0 +1,87 @@
+"""Per-page KV-cache quantization: int8/fp8 page values + per-(page, kv-head) fp32 scales.
+
+The paged KV pool (`serving/kv_cache.PagedKVCachePool(kv_dtype="int8"|"fp8")`) stores
+pages in a low-bit dtype with a parallel ``[num_pages, H]`` scale pool per K and V.
+Quantization happens **on scatter** (`ops/attention.paged_scatter_kv_quantized`): every
+write re-encodes the touched pages — dequantize the page window, insert the new tokens,
+take a fresh absmax over the page's *valid* tokens per kv head, re-quantize. Because the
+absmax over a growing valid region is monotone, the scale of a page changes at most a
+handful of times over its lifetime, and while it is unchanged the
+dequantize-then-requantize round trip recovers the stored code exactly (the fp32 relative
+error of ``(q * s) / s`` is far below half a quantization step), so committed tokens do
+not drift under repeated decode writes.
+
+Dequantization happens wherever pages are read: `paged_gather_kv_dequant` for the XLA
+reference attention paths, and inside the per-page DMA loop of the Pallas decode/verify
+and chunked-prefill kernels (`ops/pallas/paged_attention.py`, `prefill_attention.py`).
+
+:func:`quantize_pages` is the one encode primitive, dispatched through the central
+KernelConfig family ``paged_kv_quant`` (`ops/pallas/config.py`): the XLA lowering is the
+default and the byte-level reference; the Pallas kernel (`ops/pallas/kv_quant.py`) is
+asserted byte-identical in the interpret-mode parity suite, so pool state can never
+depend on the backend.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pallas import use_pallas
+
+# kv_dtype name -> (storage dtype, symmetric clip magnitude). int8 keeps the usual
+# [-127, 127] symmetric code book; fp8 e4m3 saturates at +-448 (the format has no inf,
+# so out-of-range values must be clipped BEFORE the cast or they convert to nan).
+KV_QUANT_DTYPES: dict[str, tuple] = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, 448.0),
+}
+
+
+def kv_qmax(dtype) -> float:
+    """Clip magnitude for a quantized page dtype (see :data:`KV_QUANT_DTYPES`)."""
+    for storage, qmax in KV_QUANT_DTYPES.values():
+        if dtype == storage:
+            return qmax
+    raise ValueError(f"{dtype} is not a quantized KV page dtype")
+
+
+def quantize_pages(
+    values: jax.Array, valid: jax.Array, qmax: float, out_dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Encode float pages ``[N, page_size, H, D]`` to ``out_dtype`` with per-(page, head)
+    scales ``[N, H]``.
+
+    ``valid`` ``[N, page_size]`` marks the token rows that hold real (committed or
+    just-written) K/V; the absmax that sets each scale is taken over valid rows only, so
+    stale garbage beyond a page's frontier can never inflate the scale. Invalid rows are
+    still encoded (clipped into range) — they are masked by the attention frontier, never
+    read unmasked, and must merely stay finite.
+    """
+    if use_pallas("paged_kv_quant"):
+        from .pallas.kv_quant import quantize_pages_pallas
+
+        return quantize_pages_pallas(values, valid, qmax, out_dtype)
+    return quantize_pages_xla(values, valid, qmax, out_dtype)
+
+
+def quantize_pages_xla(
+    values: jax.Array, valid: jax.Array, qmax: float, out_dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Plain-XLA reference encoding (the byte-level contract the Pallas kernel must hit)."""
+    masked = jnp.where(valid[:, :, None, None], values, 0.0)
+    amax = jnp.max(jnp.abs(masked), axis=(1, 3))  # [N, H]
+    # explicit reciprocal-multiply: `amax / qmax` lowers as a strength-reduced multiply
+    # on some backends and a true divide on others (1-ulp skew) — one spelling keeps the
+    # XLA reference and the Pallas kernel byte-identical
+    scales = jnp.where(amax > 0, amax * jnp.float32(1.0 / qmax), 1.0).astype(jnp.float32)
+    scaled = values / scales[:, None, :, None]
+    if jnp.issubdtype(jnp.dtype(out_dtype), jnp.integer):
+        scaled = jnp.round(scaled)
+    q = jnp.clip(scaled, -qmax, qmax).astype(out_dtype)
+    return q, scales
+
+
+def dequantize_values(q: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """Decode stored page values with broadcast-ready ``scales`` back to ``dtype``."""
+    return (q.astype(jnp.float32) * scales).astype(dtype)

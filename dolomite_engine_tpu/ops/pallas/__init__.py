@@ -1,11 +1,16 @@
 """Hand-written TPU kernel tier (ROADMAP item: benchmark-gated Pallas layer).
 
-Three kernels, each behind a per-family switch in :mod:`.config` with the plain-XLA
+Five kernels, each behind a per-family switch in :mod:`.config` with the plain-XLA
 lowering as the default and numerical reference:
 
 - :mod:`.paged_attention` — ragged paged-attention decode: serving decode/verify reads
   K/V through the page table, skipping unmapped pages and padded positions instead of
   gather-then-mask;
+- :mod:`.prefill_attention` — chunked-prefill flash attention through the page table
+  (online softmax over the per-page walk) — prefill chunks skip the worst-case
+  gathered view too;
+- :mod:`.kv_quant` — per-page quantization encode for the int8/fp8 paged KV pool's
+  quantize-on-scatter (byte-identical to the XLA reference encoding);
 - :mod:`.rmsnorm` — fused RMSNorm(+residual add) inside the transformer block;
 - :mod:`.moe` — grouped-GEMM MoE dispatch (sort-by-expert, block-padded segment GEMMs,
   scatter-combine) replacing the dense all-experts einsum.

@@ -9,6 +9,12 @@ plain-XLA lowering as the default and the numerical reference:
 - ``paged_attention``: the ragged paged-attention decode kernel (`paged_attention.py`) —
   serving decode/verify reads K/V straight through the page table instead of
   gather-then-mask.
+- ``prefill_attention``: the chunked-prefill flash kernel (`prefill_attention.py`) —
+  prefill chunks read the resident prefix through the page table with online softmax
+  instead of the worst-case gathered view (the last attention path off the kernel tier).
+- ``paged_kv_quant``: the page-quantization encode kernel (`kv_quant.py`) behind the
+  quantized paged KV pool's quantize-on-scatter (`ops/kv_quant.quantize_pages`);
+  byte-identical to the XLA reference encoding.
 - ``rmsnorm``: the fused RMSNorm(+residual add) kernel (`rmsnorm.py`) inside the
   transformer block.
 - ``moe_dispatch``: the grouped-GEMM MoE dispatch (`moe.py`) replacing the dense
@@ -35,7 +41,14 @@ from dataclasses import dataclass, fields, replace
 
 from ...enums import KernelBackend
 
-KERNEL_FAMILIES = ("splash_attention", "paged_attention", "rmsnorm", "moe_dispatch")
+KERNEL_FAMILIES = (
+    "splash_attention",
+    "paged_attention",
+    "prefill_attention",
+    "paged_kv_quant",
+    "rmsnorm",
+    "moe_dispatch",
+)
 
 
 @dataclass(frozen=True)
@@ -44,6 +57,8 @@ class KernelConfig:
 
     splash_attention: KernelBackend = KernelBackend.xla
     paged_attention: KernelBackend = KernelBackend.xla
+    prefill_attention: KernelBackend = KernelBackend.xla
+    paged_kv_quant: KernelBackend = KernelBackend.xla
     rmsnorm: KernelBackend = KernelBackend.xla
     moe_dispatch: KernelBackend = KernelBackend.xla
 

@@ -52,20 +52,21 @@ def _paged_decode_kernel(
     # scalar prefetch
     lengths_ref,  # [S] int32
     table_ref,  # [S, max_pages] int32
-    # inputs
-    q_ref,  # [1, W, Hq, D] VMEM block (this row)
-    k_ref,  # [num_pages, page_size, Hkv, D] in ANY/HBM
-    v_ref,  # [num_pages, page_size, Hkv, D] in ANY/HBM
-    # output
-    o_ref,  # [1, W, Hq, D] VMEM block
-    # scratch
-    scores_ref,  # [W, Hkv, G, max_kv] fp32
-    page_ref,  # [page_size, Hkv, D] landing buffer for one page
-    sem,
-    *,
+    # inputs: q [1, W, Hq, D] VMEM block, k/v pages [num_pages, page_size, Hkv, D] in
+    # ANY/HBM (+ optional [num_pages, Hkv] fp32 scale pools in VMEM for quantized
+    # pools), then the [1, W, Hq, D] output block and scratch (scores, landing buffer,
+    # DMA semaphore)
+    *refs,
     softmax_scale: float,
     page_size: int,
+    quantized: bool,
 ):
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, scores_ref, page_ref, sem = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, scores_ref, page_ref, sem = refs
+        ks_ref = vs_ref = None
+
     row = pl.program_id(0)
     width, num_q_heads, head_dim = q_ref.shape[1:]
     num_kv_heads = page_ref.shape[1]
@@ -82,12 +83,16 @@ def _paged_decode_kernel(
     q = q_ref[0].reshape(width, num_kv_heads, group, head_dim)
 
     def qk_page(p, _):
-        copy = pltpu.make_async_copy(k_ref.at[table_ref[row, p]], page_ref, sem)
+        page = table_ref[row, p]
+        copy = pltpu.make_async_copy(k_ref.at[page], page_ref, sem)
         copy.start()
         copy.wait()
-        s = jnp.einsum(
-            "wkgd,pkd->wkgp", q, page_ref[:], preferred_element_type=jnp.float32
-        )
+        kp = page_ref[:]
+        if quantized:
+            # dequantize in VMEM with the page's per-head scale, back to the activation
+            # dtype — the same cast discipline as the XLA fallback's dequantizing gather
+            kp = (kp.astype(jnp.float32) * ks_ref[page][None, :, None]).astype(q.dtype)
+        s = jnp.einsum("wkgd,pkd->wkgp", q, kp, preferred_element_type=jnp.float32)
         scores_ref[:, :, :, pl.dslice(p * page_size, page_size)] = s * softmax_scale
         return 0
 
@@ -102,14 +107,20 @@ def _paged_decode_kernel(
     ).astype(o_ref.dtype)
 
     def pv_page(p, acc):
-        copy = pltpu.make_async_copy(v_ref.at[table_ref[row, p]], page_ref, sem)
+        page = table_ref[row, p]
+        copy = pltpu.make_async_copy(v_ref.at[page], page_ref, sem)
         copy.start()
         copy.wait()
+        vp = page_ref[:]
+        if quantized:
+            vp = (vp.astype(jnp.float32) * vs_ref[page][None, :, None]).astype(
+                probs.dtype
+            )
         page_probs = jax.lax.dynamic_slice(
             probs, (0, 0, 0, p * page_size), (width, num_kv_heads, group, page_size)
         )
         return acc + jnp.einsum(
-            "wkgp,pkd->wkgd", page_probs, page_ref[:], preferred_element_type=jnp.float32
+            "wkgp,pkd->wkgd", page_probs, vp, preferred_element_type=jnp.float32
         )
 
     out = jax.lax.fori_loop(
@@ -128,32 +139,48 @@ def paged_decode_attention(
     page_table: jax.Array,
     lengths: jax.Array,
     softmax_scale: float,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Attention for the paged decode/verify step, straight off the page table.
 
     Returns ``[S, W, Hq, D]`` — what `eager_attention` over the
     `paged_gather_kv` view with the per-row causal frontier mask produces, without ever
-    materializing the view."""
+    materializing the view. For quantized pools pass the per-page ``[num_pages, Hkv]``
+    fp32 scale pools: each DMA'd page is dequantized in VMEM, so the full dequantized
+    view is never built either."""
     num_slots, width, num_q_heads, head_dim = q.shape
     page_size, num_kv_heads = k_pages.shape[1], k_pages.shape[2]
     max_pages = page_table.shape[1]
     assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
     group = num_q_heads // num_kv_heads
+    quantized = k_scales is not None
+    assert (v_scales is not None) == quantized, "k_scales and v_scales come as a pair"
+
+    def q_index(b, lens, table):
+        return (b, 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, width, num_q_heads, head_dim), q_index),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # scale pools ride whole in VMEM ([num_pages, Hkv] fp32 is small) and the
+        # kernel indexes rows dynamically per walked page
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ]
+        operands += [k_scales, v_scales]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(num_slots,),
-        in_specs=[
-            pl.BlockSpec(
-                (1, width, num_q_heads, head_dim), lambda b, lens, table: (b, 0, 0, 0)
-            ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, width, num_q_heads, head_dim), lambda b, lens, table: (b, 0, 0, 0)
-        ),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, width, num_q_heads, head_dim), q_index),
         scratch_shapes=[
             pltpu.VMEM((width, num_kv_heads, group, max_pages * page_size), jnp.float32),
             pltpu.VMEM((page_size, num_kv_heads, head_dim), k_pages.dtype),
@@ -161,11 +188,14 @@ def paged_decode_attention(
         ],
     )
     kernel = functools.partial(
-        _paged_decode_kernel, softmax_scale=float(softmax_scale), page_size=page_size  # dolint: disable=tracer-python-cast (static kernel param)
+        _paged_decode_kernel,
+        softmax_scale=float(softmax_scale),  # dolint: disable=tracer-python-cast (static kernel param)
+        page_size=page_size,
+        quantized=quantized,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret_default(interpret),
-    )(lengths.astype(jnp.int32), page_table.astype(jnp.int32), q, k_pages, v_pages)
+    )(lengths.astype(jnp.int32), page_table.astype(jnp.int32), *operands)

@@ -38,14 +38,13 @@ from ..scheduler import RequestState
 def _copy_pages(dst_caches: KVCacheList, src_caches: KVCacheList, dst_index, src_index):
     """Scatter `src` pages onto `dst` pages in every layer. Index vectors have a fixed
     padded width; pad lanes map trash->trash (page 0 on both sides), where duplicate
-    writes are harmless by the trash-page contract."""
+    writes are harmless by the trash-page contract. Every per-layer array is page-major
+    (pages at dim 0), so a quantized pool's scale rows move with their page bytes —
+    the transferred (values, scale) pairs decode identically on the destination."""
     out = []
     for dst, src in zip(dst_caches, src_caches):
         out.append(
-            {
-                "k": dst["k"].at[dst_index].set(src["k"][src_index]),
-                "v": dst["v"].at[dst_index].set(src["v"][src_index]),
-            }
+            {name: dst[name].at[dst_index].set(src[name][src_index]) for name in dst}
         )
     return out
 
@@ -80,6 +79,12 @@ class KVHandoff:
             raise ValueError(
                 f"KV handoff needs equal page sizes, got {src_pool.page_size} -> "
                 f"{dst_pool.page_size}"
+            )
+        if src_pool.kv_dtype != dst_pool.kv_dtype:
+            raise ValueError(
+                f"KV handoff needs equal kv_dtype, got {src_pool.kv_dtype!r} -> "
+                f"{dst_pool.kv_dtype!r} (quantized page bytes only decode with their "
+                "own format's scales)"
             )
         assert len(src_pages) == len(dst_pages), (src_pages, dst_pages)
         width = dst_pool.max_pages_per_slot
@@ -128,6 +133,8 @@ class DisaggregatedEngine:
                 raise ValueError("decode engines must be paged, non-prefill_only")
             if engine.pool.page_size != prefill_engine.pool.page_size:
                 raise ValueError("prefill and decode pools must share a page size")
+            if engine.pool.kv_dtype != prefill_engine.pool.kv_dtype:
+                raise ValueError("prefill and decode pools must share a kv_dtype")
         self.prefill = prefill_engine
         self.workers = decode_engines
         self.handoff = KVHandoff() if handoff is None else handoff

@@ -166,6 +166,12 @@ class ServingEngine:
         num_pages: physical pages in the pool (page 0 is reserved as trash). Default
             matches the dense pool's capacity; set it to your HBM budget to oversubscribe
             slots — admission reserves worst-case pages so decode can never run out.
+        kv_dtype: paged-pool page storage format (serving/kv_cache.KV_DTYPES):
+            ``"bf16"`` halves page bytes vs fp32, ``"int8"``/``"fp8"`` store quantized
+            pages with per-(page, kv-head) fp32 scales (quantize-on-scatter,
+            dequantize-on-read; ops/kv_quant.py) — roughly double the sustainable slots
+            again at a fixed HBM budget, at tolerance-level accuracy. None keeps
+            `cache_dtype` / the model dtype. Paged mode only.
         prefill_chunk_tokens: per-step prefill token budget (positive multiple of 8).
             With speculation on, the verify step's K+1 computed positions per decoding
             slot count against the same budget (`Scheduler.prefill_budget`).
@@ -214,6 +220,7 @@ class ServingEngine:
         paged: bool = True,
         page_size: int = 16,
         num_pages: int | None = None,
+        kv_dtype: str | None = None,
         prefill_chunk_tokens: int = 512,
         prefix_caching: bool = True,
         speculate_ngram: bool = False,
@@ -233,6 +240,8 @@ class ServingEngine:
             )
         if prefill_only and not paged:
             raise ValueError("prefill_only (disaggregation) requires the paged KV pool")
+        if kv_dtype is not None and not paged:
+            raise ValueError("kv_dtype (quantized/low-bit KV) requires the paged KV pool")
         if prefill_only and (speculate_ngram or draft_model is not None):
             raise ValueError("prefill_only workers do not decode, so cannot speculate")
         if prefill_bucket_multiple <= 0 or prefill_bucket_multiple % 8 != 0:
@@ -271,7 +280,8 @@ class ServingEngine:
 
         if paged:
             self.pool: Any = PagedKVCachePool(
-                model, num_slots, max_len, page_size, num_pages, cache_dtype, mesh=mesh
+                model, num_slots, max_len, page_size, num_pages, cache_dtype, mesh=mesh,
+                kv_dtype=kv_dtype,
             )
             self.prefix = PrefixCache(page_size) if prefix_caching else None
         else:
@@ -364,8 +374,9 @@ class ServingEngine:
         self, variables, caches, page_table, tokens, lengths, rngs, do_sample, temperature, top_k, top_p
     ):
         # one shared [S, max_pages] table serves every layer; rows of slots that are idle
-        # or mid-prefill are zeroed by the host, so their garbage token lands in trash
-        kv = [{"k": c["k"], "v": c["v"], "page_table": page_table} for c in caches]
+        # or mid-prefill are zeroed by the host, so their garbage token lands in trash.
+        # (**c carries the quantized pools' scale arrays along with the pages)
+        kv = [{**c, "page_table": page_table} for c in caches]
         out = self.model.apply(
             variables,
             tokens[:, None],
@@ -378,7 +389,9 @@ class ServingEngine:
         next_tokens = sample_tokens_vectorized(
             logits, split[:, 1], do_sample, temperature, top_k, top_p
         )
-        new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
+        new_caches = [
+            {k: v for k, v in c.items() if k != "page_table"} for c in out.kv_caches
+        ]
         return new_caches, next_tokens, split[:, 0]
 
     def _verify_impl(
@@ -408,7 +421,7 @@ class ServingEngine:
         """Paged verify: identical acceptance, but the K+1 writes scatter through each
         row's page table — unmapped window positions (idle rows, overhang past the
         request's worst-case pages) land in the trash page."""
-        kv = [{"k": c["k"], "v": c["v"], "page_table": page_table} for c in caches]
+        kv = [{**c, "page_table": page_table} for c in caches]
         width = tokens.shape[1]
         positions = lengths[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
         out = self.model.apply(
@@ -421,7 +434,9 @@ class ServingEngine:
         accepted, bonus, carry = speculative_accept(
             out.logits, tokens[:, 1:], num_drafts, rngs, do_sample, temperature, top_k, top_p
         )
-        new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
+        new_caches = [
+            {k: v for k, v in c.items() if k != "page_table"} for c in out.kv_caches
+        ]
         return new_caches, accepted, bonus, carry
 
     def _get_prefill_fn(self, bucket: int):
@@ -466,7 +481,7 @@ class ServingEngine:
         if fn is None:
 
             def chunk(variables, caches, table_row, ids, mask, start, num_real, rng, do_sample, temperature, top_k, top_p):
-                kv = [{"k": c["k"], "v": c["v"], "page_table": table_row} for c in caches]
+                kv = [{**c, "page_table": table_row} for c in caches]
                 position_ids = (start + jnp.arange(width, dtype=jnp.int32))[None, :]
                 out = self.model.apply(
                     variables,
@@ -476,7 +491,10 @@ class ServingEngine:
                     kv_caches=kv,
                     cache_index=start,
                 )
-                new_caches = [{"k": c["k"], "v": c["v"]} for c in out.kv_caches]
+                new_caches = [
+                    {k: v for k, v in c.items() if k != "page_table"}
+                    for c in out.kv_caches
+                ]
                 if not final:
                     return new_caches
                 last = jax.lax.dynamic_slice_in_dim(out.logits, num_real - 1, 1, axis=1)[:, 0]
@@ -1235,6 +1253,8 @@ class ServingEngine:
         self._last_record_step = self._step_count
         telemetry.gauge("serving/queue_depth", self.scheduler.queue_depth)
         telemetry.gauge("serving/slot_occupancy", self.pool.occupancy)
+        kv_bytes = round(self.pool.kv_bytes_per_token, 2)
+        telemetry.gauge("serving/kv_bytes_per_token", kv_bytes)
         pages_in_use = fragmentation = None
         if self.paged:
             pages_in_use = self.pool.pages_in_use
@@ -1262,6 +1282,8 @@ class ServingEngine:
             pages_in_use=pages_in_use,
             pages_total=self.pool.num_pages - 1 if self.paged else None,
             page_fragmentation=fragmentation,
+            kv_dtype=getattr(self.pool, "kv_dtype", None),
+            kv_bytes_per_token=kv_bytes,
             ttft_ms=None if ttft is None else round(ttft * 1e3, 3),
             prefill_tok_s=None if prefill_rate is None else round(prefill_rate, 1),
             decode_tok_s=None if decode_rate is None else round(decode_rate, 1),

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -31,13 +32,25 @@ KVCacheList = list[Any]  # per-layer {"k": [S, L, H, D], "v": ...} (models/model
 
 TRASH_PAGE = 0  # page-table sentinel: unmapped logical page / garbage-write target
 
+# `kv_dtype` spellings for the paged pool: plain storage dtypes plus the quantized
+# formats (low-bit page values + per-(page, kv-head) fp32 scale pools; encode/decode in
+# ops/kv_quant.py). bf16 halves page bytes vs fp32 with bit-exact greedy outputs when
+# the model already runs bf16; int8/fp8 halve them again at tolerance-level accuracy.
+KV_DTYPES: dict[str, Any] = {
+    "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "fp8": jnp.float8_e4m3fn,
+}
+QUANTIZED_KV_DTYPES = ("int8", "fp8")
+
 
 def shard_kv_caches(caches: KVCacheList, mesh: Mesh | None) -> KVCacheList:
     """Place a pool's K/V arrays with the kv-heads dim split over the mesh "tp" axis.
 
     Both pool layouts put heads at dim 2 (dense ``[slots, len, H, D]``, paged
-    ``[pages, page, H, D]``), mirroring the model's ``act_kv_heads -> tp`` activation
-    rule so the sharded decode step reads/writes its local head shard without
+    ``[pages, page, H, D]``); a quantized pool's ``[pages, H]`` scale pools carry heads
+    at dim 1 and shard with their pages. This mirrors the model's ``act_kv_heads -> tp``
+    activation rule so the sharded decode step reads/writes its local head shard without
     collectives. Heads that don't divide tp fall back to replication (the same escape
     hatch as `parallel.sharding.prune_indivisible_spec`); no mesh is a no-op.
     """
@@ -46,20 +59,33 @@ def shard_kv_caches(caches: KVCacheList, mesh: Mesh | None) -> KVCacheList:
     tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
     out = []
     for cache in caches:
-        heads = cache["k"].shape[2]
-        spec = (
-            PartitionSpec(None, None, "tp", None)
-            if tp > 1 and heads % tp == 0
-            else PartitionSpec()
-        )
-        sharding = NamedSharding(mesh, spec)
-        out.append(
-            {
-                "k": jax.device_put(cache["k"], sharding),
-                "v": jax.device_put(cache["v"], sharding),
-            }
-        )
+        placed = {}
+        for name, array in cache.items():
+            heads_dim = 1 if name.endswith("_scale") else 2
+            heads = array.shape[heads_dim]
+            spec = (
+                PartitionSpec(*("tp" if i == heads_dim else None for i in range(array.ndim)))
+                if tp > 1 and heads % tp == 0
+                else PartitionSpec()
+            )
+            placed[name] = jax.device_put(array, NamedSharding(mesh, spec))
+        out.append(placed)
     return out
+
+
+def _cache_kv_bytes_per_token(caches: KVCacheList, page_size: int | None = None) -> float:
+    """Resident K/V bytes per cached token across all layers (both pool layouts store
+    token rows as ``[.., H, D]``); a quantized pool adds its per-page scale rows
+    amortized over `page_size` tokens."""
+    total = 0.0
+    for cache in caches:
+        heads, head_dim = cache["k"].shape[2:]
+        for name in ("k", "v"):
+            total += heads * head_dim * jnp.dtype(cache[name].dtype).itemsize
+            scale = cache.get(f"{name}_scale")
+            if scale is not None and page_size:
+                total += heads * jnp.dtype(scale.dtype).itemsize / page_size
+    return total
 
 
 class SlotKVCachePool:
@@ -88,6 +114,11 @@ class SlotKVCachePool:
         # slot index itself is traced, so slots don't multiply compilations) — the same
         # pattern as the engine's `_prefill_fns`
         self._insert_fns: dict[int, Any] = {}
+
+    @property
+    def kv_bytes_per_token(self) -> float:
+        """Resident K/V bytes per cached token (all layers) — HBM sizing telemetry."""
+        return _cache_kv_bytes_per_token(self.caches)
 
     # ------------------------------------------------------------------ allocation
 
@@ -177,13 +208,21 @@ class PagedKVCachePool:
         num_pages: int | None = None,
         dtype=None,
         mesh: Mesh | None = None,
+        kv_dtype: str | None = None,
     ) -> None:
         assert num_slots > 0 and max_len > 0, (num_slots, max_len)
         if page_size <= 0 or page_size % 8 != 0:
             raise ValueError(f"page_size must be a positive multiple of 8, got {page_size}")
+        if kv_dtype is not None and kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {sorted(KV_DTYPES)} (or None for the model/"
+                f"cache dtype), got {kv_dtype!r}"
+            )
         self.num_slots = num_slots
         self.max_len = max_len
         self.page_size = page_size
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype in QUANTIZED_KV_DTYPES
         self.max_pages_per_slot = -(-max_len // page_size)
         if num_pages is None:
             # dense-parity capacity by default (plus the trash page): the paged pool is
@@ -195,10 +234,19 @@ class PagedKVCachePool:
         self.num_pages = num_pages
 
         # pages, not slot rows: [num_pages, page_size, H, D] per layer — same
-        # init_kv_caches layout with "batch" = pages and "length" = page_size
-        self.caches: KVCacheList = shard_kv_caches(
-            model.init_kv_caches(num_pages, page_size, dtype), mesh
+        # init_kv_caches layout with "batch" = pages and "length" = page_size.
+        # Quantized dtypes store low-bit page values plus per-(page, kv-head) fp32
+        # scale pools riding in the same per-layer dict (scale 1.0 == "decodes to 0"
+        # for the zero-initialized pages, so a fresh pool is well-formed).
+        caches = model.init_kv_caches(
+            num_pages, page_size, KV_DTYPES[kv_dtype] if kv_dtype else dtype
         )
+        if self.quantized:
+            for cache in caches:
+                heads = cache["k"].shape[2]
+                cache["k_scale"] = jnp.ones((num_pages, heads), jnp.float32)
+                cache["v_scale"] = jnp.ones((num_pages, heads), jnp.float32)
+        self.caches: KVCacheList = shard_kv_caches(caches, mesh)
         self.page_table = np.zeros((num_slots, self.max_pages_per_slot), np.int32)
         self.lengths = np.zeros(num_slots, np.int32)
         self.refcounts = np.zeros(num_pages, np.int32)
@@ -250,6 +298,13 @@ class PagedKVCachePool:
         self._slot_reserved[slot] = 0
 
     # ------------------------------------------------------------------ page accounting
+
+    @property
+    def kv_bytes_per_token(self) -> float:
+        """Resident K/V bytes per cached token (all layers), including the quantized
+        scale pools' per-page overhead amortized over the page — the quantity the HBM
+        sizing formula (docs/SERVING.md) and the `--kv-dtype` bench A/B budget by."""
+        return _cache_kv_bytes_per_token(self.caches, self.page_size)
 
     @property
     def pages_in_use(self) -> int:
@@ -329,7 +384,10 @@ class PagedKVCachePool:
 
 
 def _copy_page(pool_caches: KVCacheList, src, dst) -> KVCacheList:
+    # every per-layer array is page-major (pages at dim 0), so the COW copy moves the
+    # quantized scale rows together with their page bytes — chain identity holds for
+    # the (values, scale) pair
     return [
-        {"k": c["k"].at[dst].set(c["k"][src]), "v": c["v"].at[dst].set(c["v"][src])}
+        {name: array.at[dst].set(array[src]) for name, array in c.items()}
         for c in pool_caches
     ]

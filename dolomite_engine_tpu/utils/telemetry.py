@@ -110,6 +110,12 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         "pages_in_use",
         "pages_total",
         "page_fragmentation",
+        # paged KV storage format ("bf16"/"int8"/"fp8", null = model/cache dtype) and
+        # resident K/V bytes per cached token incl. quantized scale-pool overhead
+        # (serving/kv_cache.kv_bytes_per_token) — how the HBM sizing formula and the
+        # --kv-dtype bench A/B attribute capacity
+        "kv_dtype",
+        "kv_bytes_per_token",
         "ttft_ms",
         "prefill_tok_s",
         "decode_tok_s",
@@ -204,6 +210,10 @@ KNOWN_GAUGES: tuple[str, ...] = (
     # index, and the fraction of allocated page capacity not holding valid tokens
     "serving/pages_in_use",
     "serving/page_fragmentation",
+    # resident K/V bytes per cached token (all layers; quantized pools include their
+    # per-page scale rows amortized over the page) — halves under kv_dtype=bf16 vs
+    # fp32 and halves again under int8/fp8
+    "serving/kv_bytes_per_token",
     # speculative decoding (serving/engine.py): cumulative draft acceptance rate and
     # accepted draft tokens per verify step (only written when speculation is enabled)
     "serving/accept_rate",

@@ -250,7 +250,14 @@ def test_kernel_contract_clean_on_repo():
     checker = KernelContractChecker()
     result = run_checkers([checker], baseline=Counter())
     assert result.new_findings == []
-    assert checker._families == {"splash_attention", "paged_attention", "rmsnorm", "moe_dispatch"}
+    assert checker._families == {
+        "splash_attention",
+        "paged_attention",
+        "prefill_attention",
+        "paged_kv_quant",
+        "rmsnorm",
+        "moe_dispatch",
+    }
 
 
 def test_kernel_unknown_family_flagged(tmp_path):

@@ -301,6 +301,158 @@ def test_paged_attention_step_updates_cache_identically():
     np.testing.assert_array_equal(np.asarray(xla_cache["v"]), np.asarray(kernel_cache["v"]))
 
 
+# ------------------------------------------------------------------- prefill attention
+
+
+def _prefill_fixtures(seed=0, num_rows=2, width=24, q_heads=8, kv_heads=2, head_dim=16):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    num_pages, max_pages = 16, 4
+    q = jax.random.normal(ks[0], (num_rows, width, q_heads, head_dim), jnp.float32)
+    k_pages = jax.random.normal(ks[1], (num_pages, PAGE, kv_heads, head_dim), jnp.float32)
+    v_pages = jax.random.normal(ks[2], (num_pages, PAGE, kv_heads, head_dim), jnp.float32)
+    # ragged chunk starts: row 0 continues a resident prefix mid-page, row 1 starts cold;
+    # pages past each row's frontier stay TRASH (0) — the walk must never read them as real
+    table = np.zeros((num_rows, max_pages), np.int32)
+    table[0, :3] = [1, 2, 3]
+    if num_rows > 1:
+        table[1, :2] = [4, 5]
+    starts = np.array([10, 0], np.int32)[:num_rows]
+    return q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(starts)
+
+
+def _prefill_reference(q, k_pages, v_pages, table, starts, scale):
+    """What the XLA chunk path lowers to: gather the view, per-row causal frontier at
+    ``start + row``, eager fp32-softmax attention (the chunk's key-side prefix mask is
+    redundant with causality for real rows — see `_paged_prefill_eligible`)."""
+    width = q.shape[1]
+    view_len = table.shape[1] * PAGE
+    mask = make_attention_mask(
+        q.shape[0], width, view_len, causal=True, query_offset=starts
+    )
+    return eager_attention(
+        q, paged_gather_kv(k_pages, table), paged_gather_kv(v_pages, table),
+        mask, None, scale,
+    )
+
+
+@pytest.mark.parametrize("width", [8, 24])  # one q-block and a multi-block chunk
+def test_prefill_attention_kernel_parity(width):
+    from dolomite_engine_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+
+    q, k_pages, v_pages, table, starts = _prefill_fixtures(width=width)
+    scale = q.shape[-1] ** -0.5
+    out = paged_prefill_attention(q, k_pages, v_pages, table, starts, scale)
+    ref = _prefill_reference(q, k_pages, v_pages, table, starts, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_prefill_attention_kernel_mha_and_under_jit():
+    from dolomite_engine_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+
+    q, k_pages, v_pages, table, starts = _prefill_fixtures(seed=1, q_heads=4, kv_heads=4)
+    scale = q.shape[-1] ** -0.5
+    out = jax.jit(
+        lambda *a: paged_prefill_attention(*a, softmax_scale=scale)
+    )(q, k_pages, v_pages, table, starts)
+    ref = _prefill_reference(q, k_pages, v_pages, table, starts, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_prefill_attention_quantized_pages():
+    """The kernel's per-page DMA dequant must match attention over the dequantizing
+    gather (`paged_gather_kv_dequant`) on an int8 pool with non-trivial scales."""
+    from dolomite_engine_tpu.ops.attention import paged_gather_kv_dequant
+    from dolomite_engine_tpu.ops.kv_quant import quantize_pages_xla
+    from dolomite_engine_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+
+    q, k_pages, v_pages, table, starts = _prefill_fixtures(seed=2)
+    valid = jnp.ones((k_pages.shape[0], PAGE), bool)
+    k_q, k_s = quantize_pages_xla(k_pages * 3.0, valid, 127.0, jnp.int8)
+    v_q, v_s = quantize_pages_xla(v_pages * 0.5, valid, 127.0, jnp.int8)
+    scale = q.shape[-1] ** -0.5
+    out = paged_prefill_attention(
+        q, k_q, v_q, table, starts, scale, k_scales=k_s, v_scales=v_s
+    )
+    ref = eager_attention(
+        q,
+        paged_gather_kv_dequant(k_q, k_s, table, jnp.float32),
+        paged_gather_kv_dequant(v_q, v_s, table, jnp.float32),
+        make_attention_mask(
+            q.shape[0], q.shape[1], table.shape[1] * PAGE, causal=True,
+            query_offset=starts,
+        ),
+        None,
+        scale,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_prefill_step_updates_cache_identically():
+    """The prefill-kernel path's scatter (incl. the mask-derived pad-to-trash redirect)
+    must leave the page pool bit-identical to the XLA chunk path's."""
+    from dolomite_engine_tpu.models.modeling_utils import (
+        _paged_prefill_pallas_attention,
+        _update_paged_kv_cache,
+    )
+
+    q, k_pages, v_pages, table, starts = _prefill_fixtures(seed=3, num_rows=1, width=24)
+    new_k = jax.random.normal(jax.random.PRNGKey(9), (1, 24, 2, 16), jnp.float32)
+    new_v = jax.random.normal(jax.random.PRNGKey(10), (1, 24, 2, 16), jnp.float32)
+    cache = {"k": k_pages, "v": v_pages, "page_table": table[:1]}
+    start = jnp.asarray(int(starts[0]), jnp.int32)
+    # the chunk's key-side mask: resident prefix + 20 real tokens, 4-token pad tail
+    mask = np.zeros((1, table.shape[1] * PAGE), np.int32)
+    mask[0, : int(starts[0]) + 20] = 1
+    mask = jnp.asarray(mask)
+
+    _, _, xla_cache, _, _ = _update_paged_kv_cache(new_k, new_v, dict(cache), start, mask)
+    _, kernel_cache = _paged_prefill_pallas_attention(
+        q[:1], new_k, new_v, dict(cache), start, mask, 0.25
+    )
+    np.testing.assert_array_equal(np.asarray(xla_cache["k"]), np.asarray(kernel_cache["k"]))
+    np.testing.assert_array_equal(np.asarray(xla_cache["v"]), np.asarray(kernel_cache["v"]))
+
+
+# ------------------------------------------------------------------- paged kv quant
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_paged_kv_quant_kernel_bytes_identical(kv_dtype):
+    """The ``paged_kv_quant`` Pallas encode must be BYTE-identical to the XLA reference
+    — pool state can never depend on the backend."""
+    from dolomite_engine_tpu.ops.kv_quant import (
+        KV_QUANT_DTYPES,
+        quantize_pages_xla,
+    )
+    from dolomite_engine_tpu.ops.pallas.kv_quant import quantize_pages_pallas
+
+    dtype, qmax = KV_QUANT_DTYPES[kv_dtype]
+    rs = np.random.RandomState(11)
+    values = jnp.asarray(rs.randn(6, PAGE, 2, 8) * 2.0, jnp.float32)
+    valid = jnp.asarray(rs.rand(6, PAGE) > 0.3)
+    q_ref, s_ref = quantize_pages_xla(values, valid, qmax, dtype)
+    q_ker, s_ker = quantize_pages_pallas(values, valid, qmax, dtype)
+    np.testing.assert_array_equal(
+        np.asarray(q_ref).view(np.uint8), np.asarray(q_ker).view(np.uint8)
+    )
+    np.testing.assert_array_equal(np.asarray(s_ref), np.asarray(s_ker))
+
+
+def test_paged_kv_quant_scale_ignores_stale_tail():
+    """Scales come from the VALID token rows only: garbage beyond the frontier must not
+    inflate them (the rollback/trash discipline depends on this)."""
+    from dolomite_engine_tpu.ops.kv_quant import quantize_pages_xla
+
+    values = np.ones((1, PAGE, 1, 4), np.float32)
+    values[0, PAGE - 1] = 1e6  # stale garbage in the last row
+    valid = np.zeros((1, PAGE), bool)
+    valid[0, : PAGE - 1] = True
+    _, scales = quantize_pages_xla(
+        jnp.asarray(values), jnp.asarray(valid), 127.0, jnp.int8
+    )
+    np.testing.assert_allclose(np.asarray(scales), 1.0 / 127.0, rtol=1e-6)
+
+
 # ------------------------------------------------------------------- grouped moe
 
 
@@ -508,6 +660,77 @@ def test_engine_paged_kernel_parity_with_speculation():
         ), f"request {i} diverged"
 
 
+def test_engine_prefill_kernel_parity_and_compile_once():
+    """Acceptance: with the ``prefill_attention`` kernel enabled, chunked prefill stays
+    token-for-token equal to `generate_tokens` (XLA reference) with paged KV + prefix
+    cache + chunked prefill active, and the one-compile decode invariant holds — prefill
+    was the last attention path still on the worst-case gathered view."""
+    config, model, params = _make_model()
+    rs = np.random.RandomState(7)
+    shared = list(map(int, rs.randint(3, config.vocab_size, 2 * PAGE)))
+    prompts = [
+        shared + list(map(int, rs.randint(3, config.vocab_size, 5))),
+        list(map(int, rs.randint(3, config.vocab_size, 41))),
+        shared + list(map(int, rs.randint(3, config.vocab_size, 9))),
+    ]
+    rngs = [jax.random.PRNGKey(300 + i) for i in range(3)]
+    max_new = 12
+
+    with kernel_overrides(prefill_attention="pallas"):
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=128, prefill_bucket_multiple=8,
+            eos_token_id=None, pad_token_id=config.pad_token_id,
+            page_size=PAGE, prefill_chunk_tokens=16,
+        )
+        states = [
+            engine.submit(prompt_ids=p, max_new_tokens=max_new, rng=r)
+            for p, r in zip(prompts, rngs)
+        ]
+        engine.drain()
+        assert engine.decode_compiles == 1
+        assert engine.stats.prefix_hit_tokens > 0
+
+    for i, state in enumerate(states):
+        assert state.tokens == _expected(
+            model, params, config, prompts[i], rngs[i], max_new
+        ), f"request {i} diverged"
+
+
+def test_engine_quantized_kernels_match_quantized_xla():
+    """With an int8 pool, the full kernel stack (paged_attention + prefill_attention +
+    paged_kv_quant on Pallas) must reproduce the quantized XLA reference path
+    token-for-token: the quantize-on-scatter is shared, so the only difference is where
+    dequantization happens — and that is a pure read."""
+    config, model, params = _make_model()
+    rs = np.random.RandomState(9)
+    prompts = [
+        list(map(int, rs.randint(3, config.vocab_size, 37))),
+        list(map(int, rs.randint(3, config.vocab_size, 21))),
+    ]
+    rngs = [jax.random.PRNGKey(400 + i) for i in range(2)]
+
+    def run():
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=96, prefill_bucket_multiple=8,
+            eos_token_id=None, pad_token_id=config.pad_token_id,
+            page_size=PAGE, prefill_chunk_tokens=16, kv_dtype="int8",
+        )
+        states = [
+            engine.submit(prompt_ids=p, max_new_tokens=10, rng=r)
+            for p, r in zip(prompts, rngs)
+        ]
+        engine.drain()
+        assert engine.decode_compiles == 1
+        return [s.tokens for s in states]
+
+    xla_tokens = run()
+    with kernel_overrides(
+        paged_attention="pallas", prefill_attention="pallas", paged_kv_quant="pallas"
+    ):
+        kernel_tokens = run()
+    assert kernel_tokens == xla_tokens
+
+
 # ------------------------------------------------------------------- telemetry
 
 
@@ -539,6 +762,7 @@ def test_kernel_backends_in_telemetry_records(tmp_path):
     serving = [r for r in records if r["kind"] == "serving"][-1]
     expected = {
         "splash_attention": "xla", "paged_attention": "pallas",
+        "prefill_attention": "xla", "paged_kv_quant": "xla",
         "rmsnorm": "pallas", "moe_dispatch": "xla",
     }
     assert run_start["kernels"] == expected

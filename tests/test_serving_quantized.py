@@ -1,0 +1,302 @@
+"""Quantized paged KV cache (``kv_dtype="int8"|"fp8"|"bf16"``) engine suite.
+
+Covers what the kernel parity tests (tests/ops/test_pallas_kernels.py) don't: the pool
+contract under quantization — COW and prefix-chain identity now mean (page bytes, scale
+row) PAIRS, the disaggregation handoff must move scales with pages, admission math is
+unchanged (pages are pages; only their bytes shrank), and the one-compile invariants
+survive the extra scale arrays threading through the donated decode/verify buffers.
+
+Accuracy: int8/fp8 greedy outputs are tolerance-level (the bench's `--kv-dtype` A/B
+carries the formal accuracy gate); here the e2e assertion is a high token-match fraction
+against the fp32 reference — deterministic on the pinned CPU stack, with margin.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.generation_utils import generate_tokens
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import ServingEngine, serve_batch
+from dolomite_engine_tpu.serving.cluster import DisaggregatedEngine
+from dolomite_engine_tpu.serving.kv_cache import PagedKVCachePool
+
+from .test_commons import get_dense_test_config
+
+PAGE = 16
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+def _expected(model, params, config, prompt, rng, max_new):
+    ids = jnp.asarray([prompt], jnp.int32)
+    out, _ = generate_tokens(
+        model, params, ids, jnp.ones_like(ids), rng, max_new_tokens=max_new,
+        do_sample=False, eos_token_id=None, pad_token_id=config.pad_token_id,
+    )
+    return [int(t) for t in np.asarray(out[0])]
+
+
+def _make_engine(config, model, params, **overrides):
+    kwargs = dict(
+        num_slots=2, max_len=96, prefill_bucket_multiple=8, eos_token_id=None,
+        pad_token_id=config.pad_token_id, page_size=PAGE, prefill_chunk_tokens=16,
+        kv_dtype="int8",
+    )
+    kwargs.update(overrides)
+    return ServingEngine(model, params, **kwargs)
+
+
+# ---------------------------------------------------------------------------- pool
+
+
+def test_pool_validation_and_layout():
+    config, model, _ = _tiny_model()
+    with pytest.raises(ValueError, match="kv_dtype"):
+        PagedKVCachePool(model, 2, 64, PAGE, kv_dtype="int4")
+    pool = PagedKVCachePool(model, 2, 64, PAGE, kv_dtype="int8")
+    cache = pool.caches[0]
+    assert cache["k"].dtype == jnp.int8 and cache["v"].dtype == jnp.int8
+    assert cache["k_scale"].shape == (pool.num_pages, cache["k"].shape[2])
+    assert cache["k_scale"].dtype == jnp.float32
+    assert pool.quantized and pool.kv_dtype == "int8"
+    bf16 = PagedKVCachePool(model, 2, 64, PAGE, kv_dtype="bf16")
+    assert bf16.caches[0]["k"].dtype == jnp.bfloat16 and not bf16.quantized
+    assert "k_scale" not in bf16.caches[0]
+
+
+def test_kv_bytes_per_token_halves_twice():
+    """fp32 -> bf16 halves page bytes; bf16 -> int8 (values + amortized scales) is
+    ~2x again — the capacity math behind the >= 1.8x sustainable-slots acceptance."""
+    config, model, _ = _tiny_model()
+    fp32 = PagedKVCachePool(model, 2, 64, PAGE)
+    bf16 = PagedKVCachePool(model, 2, 64, PAGE, kv_dtype="bf16")
+    int8 = PagedKVCachePool(model, 2, 64, PAGE, kv_dtype="int8")
+    assert bf16.kv_bytes_per_token == fp32.kv_bytes_per_token / 2
+    ratio = bf16.kv_bytes_per_token / int8.kv_bytes_per_token
+    assert 1.8 <= ratio <= 2.0  # scale rows cost a little of the 2x
+
+
+def test_engine_rejects_dense_kv_dtype():
+    config, model, params = _tiny_model()
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(
+            model, params, num_slots=1, max_len=32, paged=False, kv_dtype="int8",
+            pad_token_id=config.pad_token_id,
+        )
+
+
+# ---------------------------------------------------------------------------- engine e2e
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_quantized_engine_greedy_accuracy(kv_dtype):
+    """Greedy decode over a quantized pool tracks the fp32 reference closely (the tiny
+    test model matches token-for-token on the pinned stack; assert with margin) and the
+    one-compile decode invariant holds with the scale pools threading through the
+    donated buffers."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(31)
+    prompts = [_random_prompt(rs, config, n) for n in (41, 21, 37)]
+    rngs = [jax.random.PRNGKey(500 + i) for i in range(3)]
+    max_new = 12
+
+    engine = _make_engine(config, model, params, max_len=128, kv_dtype=kv_dtype)
+    states = [
+        engine.submit(prompt_ids=p, max_new_tokens=max_new, rng=r)
+        for p, r in zip(prompts, rngs)
+    ]
+    engine.drain()
+    assert engine.decode_compiles == 1
+    for state, prompt, rng in zip(states, prompts, rngs):
+        reference = _expected(model, params, config, prompt, rng, max_new)
+        matched = sum(a == b for a, b in zip(state.tokens, reference)) / max_new
+        assert matched >= 0.75, (state.tokens, reference)
+
+
+def test_quantized_cow_tail_page_and_scale_isolation():
+    """COW under quantization: the donor's page BYTES and its SCALE rows are both
+    bit-identical after the sharer decodes over its private copy — a scale-only
+    mutation would silently re-decode the donor's codes differently."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(21)
+    engine = _make_engine(config, model, params, max_len=64)
+    shared = _random_prompt(rs, config, PAGE + 6)
+    prompt_a = shared + _random_prompt(rs, config, 3)
+    prompt_b = shared + _random_prompt(rs, config, 5)
+
+    serve_batch(
+        engine, [dict(prompt_ids=prompt_a, max_new_tokens=12, rng=jax.random.PRNGKey(1))]
+    )
+    match = engine.prefix.match(prompt_b)
+    assert len(match.nodes) == 1 and match.cow is not None
+    donor = match.cow.page
+    before = {
+        name: np.asarray(engine.pool.caches[0][name][donor]).copy()
+        for name in ("k", "v", "k_scale", "v_scale")
+    }
+
+    serve_batch(
+        engine, [dict(prompt_ids=prompt_b, max_new_tokens=3, rng=jax.random.PRNGKey(2))]
+    )
+    for name, value in before.items():
+        np.testing.assert_array_equal(
+            value, np.asarray(engine.pool.caches[0][name][donor]), err_msg=name
+        )
+    assert engine.stats.prefix_hit_tokens > 0
+
+
+def test_quantized_prefix_chain_reuse_matches_cold():
+    """A prefix-cache hit over quantized pages reproduces the cold-path output exactly:
+    the resident (codes, scale) pairs decode to the same K/V the full prefill would
+    have written (registration keeps both)."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(13)
+    shared = _random_prompt(rs, config, 2 * PAGE)
+    tail = _random_prompt(rs, config, 5)
+    rng = jax.random.PRNGKey(77)
+
+    cold = _make_engine(config, model, params)
+    cold_tokens = serve_batch(
+        cold, [dict(prompt_ids=shared + tail, max_new_tokens=8, rng=rng)]
+    )[0].tokens
+    assert cold.stats.prefix_hit_tokens == 0
+
+    warm = _make_engine(config, model, params)
+    serve_batch(
+        warm,
+        [dict(prompt_ids=shared + _random_prompt(rs, config, 4), max_new_tokens=4,
+              rng=jax.random.PRNGKey(78))],
+    )
+    state = serve_batch(
+        warm, [dict(prompt_ids=shared + tail, max_new_tokens=8, rng=rng)]
+    )[0]
+    assert warm.stats.prefix_hit_tokens >= 2 * PAGE
+    assert state.tokens == cold_tokens
+
+
+def test_quantized_handoff_moves_scales_with_pages():
+    """Disaggregation: transferred pages arrive byte-identical WITH their scale rows;
+    decode after adoption matches the monolithic quantized engine token-for-token."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(2)
+    prompt = _random_prompt(rs, config, 2 * PAGE + 3)
+    rng = jax.random.PRNGKey(5)
+
+    mono = _make_engine(config, model, params, num_slots=2, max_len=96)
+    expected = serve_batch(
+        mono, [dict(prompt_ids=prompt, max_new_tokens=6, rng=rng)]
+    )[0].tokens
+
+    prefill = _make_engine(config, model, params, prefill_only=True)
+    worker = _make_engine(config, model, params)
+    disagg = DisaggregatedEngine(prefill, [worker])
+
+    captured = {}
+    original = disagg.handoff.transfer
+
+    def capture(src_pool, src_pages, dst_pool, dst_pages):
+        captured["src"] = [
+            (np.asarray(src_pool.caches[0]["k"][p]).copy(),
+             np.asarray(src_pool.caches[0]["k_scale"][p]).copy())
+            for p in src_pages
+        ]
+        original(src_pool, src_pages, dst_pool, dst_pages)
+        captured["dst"] = [
+            (np.asarray(dst_pool.caches[0]["k"][p]).copy(),
+             np.asarray(dst_pool.caches[0]["k_scale"][p]).copy())
+            for p in dst_pages
+        ]
+
+    disagg.handoff.transfer = capture
+    state = disagg.submit(prompt_ids=prompt, max_new_tokens=6, rng=rng)
+    disagg.drain()
+
+    assert state.tokens == expected
+    assert disagg.handoff.transfers == 1
+    for (src_bytes, src_scale), (dst_bytes, dst_scale) in zip(
+        captured["src"], captured["dst"]
+    ):
+        np.testing.assert_array_equal(src_bytes, dst_bytes)
+        np.testing.assert_array_equal(src_scale, dst_scale)
+
+
+def test_quantized_handoff_dtype_mismatch_rejected():
+    config, model, params = _tiny_model()
+    prefill = _make_engine(config, model, params, prefill_only=True, kv_dtype="int8")
+    worker = _make_engine(config, model, params, kv_dtype=None)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        DisaggregatedEngine(prefill, [worker])
+
+
+def test_quantized_speculation_compiles_once():
+    """decode_compiles == 0 / verify_compiles == 1 with the quantized pool and n-gram
+    speculation active: the K+1 verify window writes, rolls back, and re-quantizes
+    through the same one compiled program across request churn."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(41)
+    prompts = [
+        (_random_prompt(rs, config, 6) * 6)[:30],
+        _random_prompt(rs, config, 21),
+        _random_prompt(rs, config, 33),
+    ]
+    engine = _make_engine(
+        config, model, params, speculate_ngram=True, draft_k=4, max_len=96
+    )
+    states = [
+        engine.submit(prompt_ids=p, max_new_tokens=12, rng=jax.random.PRNGKey(600 + i))
+        for i, p in enumerate(prompts)
+    ]
+    engine.drain()
+    assert engine.verify_compiles == 1
+    assert engine.decode_compiles == 0
+    assert engine.stats.draft_tokens_accepted > 0
+    assert all(len(s.tokens) == 12 for s in states)
+    # every slot returned; only prefix-index references keep pages resident
+    # (rollback/requantize leaked nothing)
+    assert engine.pool.num_free == engine.pool.num_slots
+    assert engine.pool.pages_in_use == len(engine.prefix)
+
+
+def test_serving_record_kv_fields(tmp_path):
+    from dolomite_engine_tpu.utils.telemetry import (
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _tiny_model()
+    sink = tmp_path / "kv.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engine = _make_engine(config, model, params)
+        engine.submit(prompt_ids=[5, 6, 7, 8], max_new_tokens=4)
+        engine.drain()
+        telemetry.close()
+    finally:
+        uninstall_telemetry()
+
+    records = [json.loads(line) for line in open(sink)]
+    serving = [r for r in records if r["kind"] == "serving"][-1]
+    assert serving["kv_dtype"] == "int8"
+    assert serving["kv_bytes_per_token"] == pytest.approx(
+        engine.pool.kv_bytes_per_token, rel=1e-3
+    )
+
+    from tools.telemetry_summary import summarize
+
+    text = summarize(records)
+    assert "int8" in text

@@ -50,6 +50,16 @@ def main() -> None:
         help="draft tokens per step for --speculate (K >= 1)",
     )
     p.add_argument(
+        "--kv-dtype",
+        type=str,
+        default=None,
+        choices=["bf16", "int8", "fp8"],
+        help="A/B a quantized paged KV pool against bf16 paged at FIXED KV HBM bytes: "
+        "sustainable concurrent slots + greedy-accuracy gate + model-dtype+pallas-"
+        "prefill bit-exactness vs generate_tokens; emits a BENCH-trajectory JSON line "
+        "with quantized_sustainable_slots_ratio and ASSERTS the >= 1.8x acceptance",
+    )
+    p.add_argument(
         "--replicas",
         type=int,
         default=0,
@@ -190,6 +200,8 @@ def main() -> None:
             )
         if args.speculate:
             record["speculate_ab"] = _bench_speculate_ab(model, params, config, args)
+        if args.kv_dtype:
+            record["kv_dtype_ab"] = _bench_kv_dtype_ab(model, params, config, args)
         if args.replicas > 0:
             record["router_ab"] = _bench_router_ab(model, params, config, args)
 
@@ -205,6 +217,21 @@ def main() -> None:
                     "unit": "x plain decode tok/s on the repetitive-text workload",
                     "vs_baseline": spec["decode_tok_s_ratio"],
                     "accepted_tokens_per_step": spec["accepted_tokens_per_step"],
+                }
+            )
+        )
+
+    if not args.seq2seq and args.kv_dtype:
+        ab = record["kv_dtype_ab"]
+        print(
+            json.dumps(
+                {
+                    "metric": "quantized_sustainable_slots_ratio",
+                    "value": ab["sustainable_slots_ratio"],
+                    "unit": f"x bf16-paged slots at fixed KV HBM bytes ({args.kv_dtype})",
+                    "vs_baseline": ab["sustainable_slots_ratio"],
+                    "greedy_token_match": ab["accuracy"]["greedy_token_match"],
+                    "kv_bytes_per_token": ab["quantized"]["kv_bytes_per_token"],
                 }
             )
         )
@@ -374,6 +401,173 @@ def _bench_speculate_ab(model, params, config, args) -> dict:
         "accept_rate": round(stats.accept_rate() or 0.0, 4),
         "accepted_tokens_per_step": round(stats.accepted_tokens_per_step() or 0.0, 3),
         "verify_compiles": engine.verify_compiles,
+    }
+
+
+def _bench_kv_dtype_ab(model, params, config, args) -> dict:
+    """Quantized-vs-bf16 paged KV at FIXED KV HBM BYTES (the acceptance A/B).
+
+    Both pools get the same byte budget (the bf16 dense-parity footprint); the
+    quantized pool's smaller pages buy proportionally more of them, and since admission
+    reserves worst-case PAGES, sustainable concurrency scales with the page count —
+    int8 page bytes are value bytes + the amortized per-page scale rows, so the
+    expected ratio is just under 2x. Three assertions ride along:
+
+    - capacity: peak concurrently-active slots on a shared-prefix mixed workload must
+      reach >= 1.8x the bf16 pool's (the PR acceptance criterion; asserted for
+      int8/fp8);
+    - accuracy gate: greedy outputs over the quantized pool must match the model-dtype
+      reference on >= 70% of tokens (CPU tiny model typically matches 100%);
+    - bit-exactness: model-native pages with the ``prefill_attention`` Pallas kernel
+      reproduce `generate_tokens` token-for-token (on TPU the model dtype IS bf16, so
+      this is the "bf16+pallas prefill bit-exact" acceptance clause).
+    """
+    import numpy as np
+
+    from dolomite_engine_tpu.generation_utils import generate_tokens
+    from dolomite_engine_tpu.ops.pallas import kernel_overrides
+    from dolomite_engine_tpu.serving import ServingEngine, serve_batch
+    from dolomite_engine_tpu.serving.kv_cache import PagedKVCachePool, QUANTIZED_KV_DTYPES
+
+    backend_tpu = jax.default_backend() == "tpu"
+    multiple = 64 if backend_tpu else 16
+    page_size = 64 if backend_tpu else 16
+    max_len = -(-args.prompt // multiple) * multiple + args.new
+    max_pages = -(-max_len // page_size)
+    budget_pages_bf16 = args.batch * max_pages
+
+    # per-dtype page bytes from throwaway pools (layers/heads/head_dim included)
+    def page_bytes(kv_dtype):
+        pool = PagedKVCachePool(model, 1, max_len, page_size, kv_dtype=kv_dtype)
+        return pool.kv_bytes_per_token * page_size, pool
+
+    bf16_page_bytes, _ = page_bytes("bf16")
+    q_page_bytes, probe_pool = page_bytes(args.kv_dtype)
+    budget_bytes = budget_pages_bf16 * bf16_page_bytes
+    budget_pages_q = int(budget_bytes // q_page_bytes)
+
+    # slot rows are cheap host state — give BOTH engines enough that the page budget
+    # (the thing the A/B fixes) is the binding constraint, not the decode batch width
+    num_slots = min(2 + budget_pages_q, 32 * args.batch)
+
+    def capacity_engine(kv_dtype, num_pages):
+        return ServingEngine(
+            model,
+            params,
+            num_slots=num_slots,
+            max_len=max_len,
+            prefill_bucket_multiple=multiple,
+            max_waiting=64 * args.batch,
+            eos_token_id=None,
+            pad_token_id=config.pad_token_id,
+            page_size=page_size,
+            num_pages=num_pages + 1,  # + trash page
+            kv_dtype=kv_dtype,
+        )
+
+    # shared system prompt + short unique tails + modest decode budgets: the same
+    # capacity workload as --paged, so the two trajectory lines compose
+    rs = np.random.RandomState(17)
+    shared = list(map(int, rs.randint(3, config.vocab_size, 2 * page_size)))
+    new_tokens = max(8, min(args.new, page_size // 2))
+    num_requests = 2 * num_slots
+
+    def capacity(kv_dtype, num_pages):
+        engine = capacity_engine(kv_dtype, num_pages)
+        specs = [
+            dict(
+                prompt_ids=shared + list(map(int, rs.randint(3, config.vocab_size, 8))),
+                max_new_tokens=new_tokens,
+            )
+            for _ in range(num_requests)
+        ]
+        serve_batch(engine, specs)
+        return engine.stats.peak_active, engine
+
+    bf16_peak, _ = capacity("bf16", budget_pages_bf16)
+    q_peak, q_engine = capacity(args.kv_dtype, budget_pages_q)
+    ratio = q_peak / max(bf16_peak, 1)
+
+    # accuracy gate: greedy tokens over the quantized pool vs the model-dtype reference
+    rs2 = np.random.RandomState(29)
+    gate_prompts = [
+        list(map(int, rs2.randint(3, config.vocab_size, args.prompt // 2 or 8)))
+        for _ in range(max(args.batch, 2))
+    ]
+    gate_rngs = [jax.random.PRNGKey(900 + i) for i in range(len(gate_prompts))]
+    gate_new = min(args.new, 16)
+
+    def reference(prompt, rng):
+        ids = jnp.asarray([prompt], jnp.int32)
+        out, _ = generate_tokens(
+            model, params, ids, jnp.ones_like(ids), rng, max_new_tokens=gate_new,
+            do_sample=False, eos_token_id=None, pad_token_id=config.pad_token_id,
+        )
+        return [int(t) for t in np.asarray(out[0])]
+
+    def engine_tokens(kv_dtype, overrides=None):
+        engine = ServingEngine(
+            model, params, num_slots=args.batch, max_len=max_len,
+            prefill_bucket_multiple=multiple, max_waiting=4 * len(gate_prompts),
+            eos_token_id=None, pad_token_id=config.pad_token_id, page_size=page_size,
+            kv_dtype=kv_dtype,
+        )
+        specs = [
+            dict(prompt_ids=list(p), max_new_tokens=gate_new, rng=r)
+            for p, r in zip(gate_prompts, gate_rngs)
+        ]
+        if overrides:
+            with kernel_overrides(**overrides):
+                states = serve_batch(engine, specs)
+        else:
+            states = serve_batch(engine, specs)
+        return [s.tokens for s in states]
+
+    refs = [reference(p, r) for p, r in zip(gate_prompts, gate_rngs)]
+    quant_tokens = engine_tokens(args.kv_dtype)
+    matched = sum(
+        sum(a == b for a, b in zip(t, ref)) for t, ref in zip(quant_tokens, refs)
+    ) / (len(refs) * gate_new)
+
+    # bit-exactness clause: model-native pages + the Pallas prefill kernel
+    native_tokens = engine_tokens(None, overrides={"prefill_attention": "pallas"})
+    prefill_bit_exact = native_tokens == refs
+
+    quantized = args.kv_dtype in QUANTIZED_KV_DTYPES
+    assert prefill_bit_exact, (
+        "model-dtype pages + pallas prefill_attention diverged from generate_tokens"
+    )
+    assert matched >= 0.7, f"greedy accuracy gate failed: {matched:.3f} < 0.7"
+    if quantized:
+        assert ratio >= 1.8, (
+            f"quantized sustainable-slots ratio {ratio:.3f} < 1.8x acceptance "
+            f"({q_peak} vs {bf16_peak} slots at {budget_bytes / 2**20:.1f} MiB KV)"
+        )
+
+    return {
+        "kv_dtype": args.kv_dtype,
+        "page_size": page_size,
+        "kv_budget_mib": round(budget_bytes / 2**20, 2),
+        "bf16": {
+            "num_pages": budget_pages_bf16,
+            "peak_active_slots": int(bf16_peak),
+            "page_bytes": round(bf16_page_bytes, 1),
+        },
+        "quantized": {
+            "num_pages": budget_pages_q,
+            "peak_active_slots": int(q_peak),
+            "page_bytes": round(q_page_bytes, 1),
+            "kv_bytes_per_token": round(probe_pool.kv_bytes_per_token, 2),
+            "decode_tok_s": round(q_engine.stats.decode_tok_s() or 0.0, 1),
+            "decode_compiles": q_engine.decode_compiles,
+        },
+        "sustainable_slots_ratio": round(ratio, 3),
+        "accuracy": {
+            "greedy_token_match": round(matched, 4),
+            "requests": len(refs),
+            "new_tokens": gate_new,
+            "prefill_pallas_bit_exact": prefill_bit_exact,
+        },
     }
 
 

@@ -31,7 +31,13 @@ import numpy as np
 
 _PEAK_TFLOPS = {"tpu": 197.0, "cpu": 0.5, "gpu": 100.0}
 
-KERNEL_AB_FAMILIES = ("paged_attention", "rmsnorm", "moe_dispatch")
+KERNEL_AB_FAMILIES = (
+    "paged_attention",
+    "prefill_attention",
+    "paged_kv_quant",
+    "rmsnorm",
+    "moe_dispatch",
+)
 
 
 def _time_jitted(fn, args, reps: int) -> float:
@@ -132,6 +138,65 @@ def _bench_kernel_family(family: str, args) -> dict:
             "q_heads": hq, "kv_heads": hkv, "head_dim": hd,
         }
         operands = (q, k_pages, v_pages)
+    elif family == "prefill_attention":
+        # chunk-shaped: one row, a wide query window, a long resident prefix — the
+        # XLA side pays the worst-case gathered view, the kernel walks resident pages
+        rows, chunk, page, max_pages, hq, hkv, hd = 1, 256, 16, 64, 8, 2, 64
+        num_pages = rows * max_pages + 1
+        q = jax.random.normal(key, (rows, chunk, hq, hd), jnp.bfloat16)
+        k_pages = jax.random.normal(
+            jax.random.PRNGKey(1), (num_pages, page, hkv, hd), jnp.bfloat16
+        )
+        v_pages = jax.random.normal(
+            jax.random.PRNGKey(2), (num_pages, page, hkv, hd), jnp.bfloat16
+        )
+        table = jnp.asarray(
+            1 + np.arange(rows * max_pages, dtype=np.int32).reshape(rows, max_pages)
+        )
+        starts = jnp.full((rows,), 8 * page, jnp.int32)  # resident prefix: 8 pages
+        scale = hd**-0.5
+        from dolomite_engine_tpu.ops.attention import (
+            eager_attention,
+            make_attention_mask,
+            paged_gather_kv,
+        )
+        from dolomite_engine_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention,
+        )
+
+        def run_xla(q, k_pages, v_pages):
+            view_len = max_pages * page
+            mask = make_attention_mask(
+                rows, chunk, view_len, causal=True, query_offset=starts
+            )
+            return eager_attention(
+                q, paged_gather_kv(k_pages, table), paged_gather_kv(v_pages, table),
+                mask, None, scale,
+            )
+
+        xla_fn = jax.jit(run_xla)
+        pallas_fn = jax.jit(
+            lambda q, k, v: paged_prefill_attention(q, k, v, table, starts, scale)
+        )
+        shape = {
+            "rows": rows, "chunk": chunk, "page_size": page, "max_pages": max_pages,
+            "q_heads": hq, "kv_heads": hkv, "head_dim": hd,
+        }
+        operands = (q, k_pages, v_pages)
+    elif family == "paged_kv_quant":
+        # scatter-shaped: the batch of touched pages one engine step re-encodes
+        pages_n, page, hkv, hd = args.micro_bs * 8, 16, 2, 64
+        values = jax.random.normal(key, (pages_n, page, hkv, hd), jnp.float32)
+        valid = jnp.asarray(
+            np.random.RandomState(0).rand(pages_n, page) > 0.25
+        )
+        from dolomite_engine_tpu.ops.kv_quant import quantize_pages_xla
+        from dolomite_engine_tpu.ops.pallas.kv_quant import quantize_pages_pallas
+
+        xla_fn = jax.jit(lambda v: quantize_pages_xla(v, valid, 127.0, jnp.int8))
+        pallas_fn = jax.jit(lambda v: quantize_pages_pallas(v, valid, 127.0, jnp.int8))
+        shape = {"pages": pages_n, "page_size": page, "kv_heads": hkv, "head_dim": hd}
+        operands = (values,)
     else:
         raise ValueError(f"unknown kernel family for A/B: {family}")
 

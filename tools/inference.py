@@ -67,6 +67,13 @@ def parse_args() -> argparse.Namespace:
         help="kernel families to run on Pallas, comma list of family[=backend] "
         "(docs/PERFORMANCE.md 'Kernel tier'); e.g. --kernels paged_attention,rmsnorm",
     )
+    p.add_argument(
+        "--kv-dtype",
+        default=None,
+        choices=["bf16", "int8", "fp8"],
+        help="paged KV page storage (int8/fp8: quantized pages + per-page scales; "
+        "default: model dtype)",
+    )
     return p.parse_args()
 
 
@@ -135,6 +142,7 @@ def main() -> None:
         eos_token_id=model.eos_token_id,
         pad_token_id=pad_token_id,
         rng=jax.random.PRNGKey(args.seed),
+        kv_dtype=args.kv_dtype,
         speculate_ngram=args.speculate_ngram,
         draft_model=draft_model,
         draft_params=draft_params,

@@ -280,6 +280,11 @@ def summarize(records: list[dict]) -> str:
             if last.get("page_fragmentation") is not None:
                 page_line += f" (frag {100.0 * last['page_fragmentation']:.1f}%)"
             parts.append(page_line)
+        if last.get("kv_bytes_per_token") is not None:
+            kv_line = f"kv {last['kv_bytes_per_token']:.0f} B/token"
+            if last.get("kv_dtype"):
+                kv_line += f" ({last['kv_dtype']})"
+            parts.append(kv_line)
         replica_ids = sorted(
             {r["replica_id"] for r in servings if r.get("replica_id") is not None}
         )
