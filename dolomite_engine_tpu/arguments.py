@@ -735,6 +735,24 @@ class GenerationParameters(BaseArgs):
     kv_dtype: str | None = None
     # share page-aligned resident prompt prefixes across requests (RadixAttention-style)
     prefix_caching: bool = True
+    # ---- scheduling under contention (serving/scheduler.py, docs/SERVING.md) ----
+    # priority tier stamped on every request this run submits: 0 is the top tier;
+    # admission, the chunked-prefill budget, and preemption-victim selection are all
+    # ordered tier-then-FCFS
+    priority: int = 0
+    # paged-KV preemption of lower-tier slots when a higher-tier request cannot admit
+    # (or an oversubscribed pool runs physically dry): "off" never evicts; "swap" parks
+    # the victim's pages in a host-memory pool (byte-identical restore); "recompute"
+    # releases pages and rebuilds through the radix prefix cache. Resumed requests are
+    # token-for-token identical to an unpreempted run
+    preemption: str = "off"
+    # admission may promise up to ratio * allocatable pages (>= 1.0): worst-case
+    # reservations strand capacity, so oversubscribing admits more concurrent work;
+    # ratio > 1 requires preemption != "off" (the shortfall must be reclaimable)
+    oversubscribe_ratio: float = 1.0
+    # multi-turn session retention window: a finished request with a session id pins
+    # its prefix pages against LRU eviction until the session idles this long
+    session_ttl_s: float = 300.0
     # ---- speculative decoding (serving/engine.py, docs/SERVING.md) ----
     # n-gram / prompt-lookup self-drafting: propose draft tokens by matching the slot's
     # recent suffix against its own prompt+generation history (no extra model; strongest
@@ -791,6 +809,25 @@ class GenerationParameters(BaseArgs):
                 )
             if not self.paged_kv_cache:
                 raise ValueError("kv_dtype requires paged_kv_cache=True")
+        if self.priority < 0:
+            raise ValueError(f"priority must be >= 0 (0 is the top tier), got {self.priority}")
+        if self.preemption not in ("off", "swap", "recompute"):
+            raise ValueError(
+                f"preemption must be 'off', 'swap', or 'recompute', got {self.preemption!r}"
+            )
+        if self.preemption != "off" and not self.paged_kv_cache:
+            raise ValueError("preemption requires paged_kv_cache=True")
+        if self.oversubscribe_ratio < 1.0:
+            raise ValueError(
+                f"oversubscribe_ratio must be >= 1.0, got {self.oversubscribe_ratio}"
+            )
+        if self.oversubscribe_ratio > 1.0 and self.preemption == "off":
+            raise ValueError(
+                "oversubscribe_ratio > 1.0 promises pages that are not physically "
+                "backed; enable preemption ('swap' or 'recompute') to make that safe"
+            )
+        if self.session_ttl_s <= 0:
+            raise ValueError(f"session_ttl_s must be positive, got {self.session_ttl_s}")
         if self.draft_k < 1:
             raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
         if self.speculate_ngram and self.draft_model is not None:

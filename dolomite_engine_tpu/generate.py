@@ -49,6 +49,10 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
         "kv_dtype",
         "prefill_chunk_tokens",
         "prefix_caching",
+        "priority",
+        "preemption",
+        "oversubscribe_ratio",
+        "session_ttl_s",
         "speculate_ngram",
         "draft_model",
         "draft_k",
@@ -162,6 +166,9 @@ def _generate_with_engine(
             kv_dtype=gp.kv_dtype,
             prefill_chunk_tokens=gp.prefill_chunk_tokens,
             prefix_caching=gp.prefix_caching,
+            preemption=gp.preemption,
+            oversubscribe_ratio=gp.oversubscribe_ratio,
+            session_ttl_s=gp.session_ttl_s,
             speculate_ngram=gp.speculate_ngram,
             draft_model=draft_model,
             draft_params=draft_params,
@@ -180,7 +187,8 @@ def _generate_with_engine(
         for replica_id in range(gp.replicas):
             if gp.disaggregate:
                 prefill = build_engine(
-                    prefill_only=True, speculate_ngram=False, draft_model=None, draft_params=None
+                    prefill_only=True, speculate_ngram=False, draft_model=None,
+                    draft_params=None, preemption="off", oversubscribe_ratio=1.0,
                 )
                 replica_engine = DisaggregatedEngine(prefill, [build_engine()])
             else:
@@ -200,6 +208,7 @@ def _generate_with_engine(
                     max_new_tokens=gp.max_new_tokens,
                     sampling=sampling,
                     rng=request_rng,
+                    priority=gp.priority,
                     on_finish=lambda state: progress_bar.update(1),
                 )
             )

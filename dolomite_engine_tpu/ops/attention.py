@@ -212,6 +212,29 @@ def paged_gather_kv_dequant(
     return logical_constraint(out, (None, None, "act_kv_heads", None))
 
 
+def gather_kv_pages(caches: list, page_index: jax.Array) -> list:
+    """Snapshot whole physical pages out of a paged pool: ``page_index`` is a fixed-width
+    ``[W]`` vector of physical page ids (padded with the trash page so one program serves
+    any request), and every per-layer array is page-major (pages at dim 0), so a
+    quantized pool's per-(page, head) scale rows ride out with their page bytes. This is
+    the swap-OUT half of paged-KV preemption (serving/engine.py ``preemption="swap"``):
+    the result is fetched to a host-memory pool and the device pages are freed."""
+    return [{name: array[page_index] for name, array in cache.items()} for cache in caches]
+
+
+def scatter_kv_pages(caches: list, payload: list, page_index: jax.Array) -> list:
+    """Swap-IN half of paged-KV preemption: write `payload` (the `gather_kv_pages`
+    snapshot, one ``[W, ...]`` leading-dim chunk per per-layer array) back onto the
+    physical pages in ``page_index``. Pad lanes map trash->trash (page 0 on both sides),
+    where duplicate writes are harmless by the trash-page contract — the same shape as
+    the KVHandoff page copy, so the pair compiles once per pool geometry and restores
+    page bytes (and quantized scale rows) exactly."""
+    return [
+        {name: cache[name].at[page_index].set(chunk[name]) for name in cache}
+        for cache, chunk in zip(caches, payload)
+    ]
+
+
 def paged_gather_kv(pages: jax.Array, page_table: jax.Array) -> jax.Array:
     """Gather each row's pages into a contiguous ``[B, max_pages * page_size, H, D]`` view.
 

@@ -1,7 +1,15 @@
 from .engine import EngineStats, ServingEngine, serve_batch
-from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool, shard_kv_caches
+from .kv_cache import TRASH_PAGE, HostSwapPool, PagedKVCachePool, SlotKVCachePool, shard_kv_caches
 from .prefix_cache import PrefixCache, PrefixMatch, PrefixNode
-from .scheduler import QueueFullError, Request, RequestState, RequestStatus, SamplingParams, Scheduler
+from .scheduler import (
+    QueueFullError,
+    Request,
+    RequestState,
+    RequestStatus,
+    SamplingParams,
+    Scheduler,
+    TierSLO,
+)
 from .speculation import DraftModelDrafter, NgramDrafter
 
 # the distributed tier imports serving.engine, so this must come after it
@@ -23,6 +31,7 @@ __all__ = [
     "DraftModelDrafter",
     "EngineReplica",
     "EngineStats",
+    "HostSwapPool",
     "KVHandoff",
     "NgramDrafter",
     "PagedKVCachePool",
@@ -38,6 +47,7 @@ __all__ = [
     "SamplingParams",
     "Scheduler",
     "ServingEngine",
+    "TierSLO",
     "SlotKVCachePool",
     "TRASH_PAGE",
     "inference_mesh",

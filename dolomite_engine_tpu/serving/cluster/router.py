@@ -5,7 +5,11 @@ engine (single-device, TP-sharded, or disaggregated) owning its own KV pool and 
 the router does admission control and replica selection using the same signals the
 engines already export as serving telemetry:
 
-- **prefix affinity first**: the replica whose prefix index holds the longest resident
+- **session affinity first**: a request carrying a ``session_id`` goes back to the
+  replica that served the session's previous turn (tracked router-side with the same
+  TTL discipline as the engines' session pins) — that replica's prefix index holds the
+  conversation's pinned pages, so the turn re-attaches instead of re-prefilling;
+- **prefix affinity next**: the replica whose prefix index holds the longest resident
   prefix for the prompt (probed side-effect-free via `prefix_match_len`) wins when the
   match covers at least one full KV page — re-prefilling a resident prefix elsewhere
   costs more than any load imbalance at page granularity;
@@ -38,6 +42,7 @@ class RouterStats:
     routed: int = 0
     rejected: int = 0
     affinity_hits: int = 0
+    session_affinity_hits: int = 0
     per_replica_routed: dict[int, int] = field(default_factory=dict)
 
     def affinity_hit_rate(self) -> float | None:
@@ -146,6 +151,8 @@ class Router:
         replicas: list[EngineReplica],
         *,
         record_interval: int = 0,
+        session_ttl_s: float = 300.0,
+        clock=time.monotonic,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -154,13 +161,38 @@ class Router:
             raise ValueError(f"duplicate replica ids: {ids}")
         self.replicas = replicas
         self.record_interval = record_interval
+        self.session_ttl_s = session_ttl_s
+        self.clock = clock
         self.stats = RouterStats()
         self._last_record_routed = 0
+        # session_id -> (replica list index, expires_at): sticky placement so every
+        # turn of a conversation lands where its pinned prefix pages live
+        self._sessions: dict[str, tuple[int, float]] = {}
 
     # ------------------------------------------------------------------ routing
 
-    def select(self, prompt_ids: list[int]) -> tuple[EngineReplica, bool]:
-        """Pick a replica for `prompt_ids`: (replica, used_prefix_affinity)."""
+    def _session_replica(self, session_id: str | None) -> EngineReplica | None:
+        """The replica remembered for a live session (None when unknown/expired)."""
+        if session_id is None:
+            return None
+        entry = self._sessions.get(session_id)
+        if entry is None:
+            return None
+        index, expires_at = entry
+        if expires_at < self.clock():
+            del self._sessions[session_id]
+            return None
+        return self.replicas[index]
+
+    def select(
+        self, prompt_ids: list[int], session_id: str | None = None
+    ) -> tuple[EngineReplica, bool]:
+        """Pick a replica for `prompt_ids`: (replica, used_affinity). A live session's
+        replica wins outright; otherwise the longest resident prefix (>= one full
+        page), otherwise least-loaded."""
+        sticky = self._session_replica(session_id)
+        if sticky is not None:
+            return sticky, True
         best: EngineReplica | None = None
         best_len = 0
         for replica in self.replicas:
@@ -174,7 +206,8 @@ class Router:
     def submit(self, **spec: Any) -> RequestState:
         """Route one request spec (the kwargs of `ServingEngine.submit`). Raises
         QueueFullError only when EVERY replica is at its admission bound."""
-        chosen, affinity = self.select(spec["prompt_ids"])
+        session_id = spec.get("session_id")
+        chosen, affinity = self.select(spec["prompt_ids"], session_id)
         candidates = [chosen] + sorted(
             (r for r in self.replicas if r is not chosen), key=lambda r: r.load()
         )
@@ -191,6 +224,15 @@ class Router:
             if affinity and replica is chosen:
                 self.stats.affinity_hits += 1
                 get_telemetry().count("router_prefix_affinity_hits")
+                if self._session_replica(session_id) is replica:
+                    self.stats.session_affinity_hits += 1
+            if session_id is not None:
+                # remember where the session actually landed (a full sticky replica may
+                # have spilled to another — the pin follows the latest placement)
+                self._sessions[session_id] = (
+                    self.replicas.index(replica),
+                    self.clock() + self.session_ttl_s,
+                )
             if (
                 self.record_interval
                 and self.stats.routed - self._last_record_routed >= self.record_interval
@@ -273,6 +315,8 @@ class Router:
                     str(k): v for k, v in sorted(self.stats.per_replica_routed.items())
                 },
                 "prefix_affinity_hit_rate": None if hit_rate is None else round(hit_rate, 4),
+                "session_affinity_hits": self.stats.session_affinity_hits,
+                "sessions_tracked": len(self._sessions),
                 "kv_handoffs": transfers,
             },
         )

@@ -57,7 +57,7 @@ from flax import linen as nn
 from ..ops.pallas import active_kernel_backends
 from ..ops.sampling import sample_tokens_vectorized, speculative_accept
 from ..utils.telemetry import get_telemetry
-from .kv_cache import TRASH_PAGE, PagedKVCachePool, SlotKVCachePool
+from .kv_cache import TRASH_PAGE, HostSwapPool, PagedKVCachePool, SlotKVCachePool
 from .prefix_cache import PrefixCache, PrefixMatch
 from .speculation import DraftModelDrafter, NgramDrafter
 from .scheduler import (
@@ -66,6 +66,7 @@ from .scheduler import (
     RequestStatus,
     SamplingParams,
     Scheduler,
+    TierSLO,
 )
 
 _DEFAULT = object()  # "use the engine default" sentinel for per-request eos overrides
@@ -99,6 +100,21 @@ class EngineStats:
     peak_active: int = 0
     draft_tokens_proposed: int = 0
     draft_tokens_accepted: int = 0
+    # contention-aware scheduling (docs/SERVING.md "Scheduling under contention"):
+    # preemptions counts slot evictions (swap or drop-and-recompute); swapped pages
+    # count page moves through the host pool; session_hits counts admissions whose
+    # live session had resident prefix pages to reuse
+    preemptions: int = 0
+    pages_swapped_out: int = 0
+    pages_swapped_in: int = 0
+    session_hits: int = 0
+    # per-tier latency samples: TTFT per admitted request, mean inter-token latency per
+    # finished request (the quantities the per-tier SLOs target)
+    ttft_s_by_tier: dict[int, list[float]] = field(default_factory=dict)
+    itl_s_by_tier: dict[int, list[float]] = field(default_factory=dict)
+    admitted_by_tier: dict[int, int] = field(default_factory=dict)
+    completed_by_tier: dict[int, int] = field(default_factory=dict)
+    preempted_by_tier: dict[int, int] = field(default_factory=dict)
 
     def prefill_tok_s(self) -> float | None:
         if self.prefill_seconds <= 0:
@@ -134,14 +150,54 @@ class EngineStats:
             return None
         return self.draft_tokens_accepted / self.decode_steps
 
+    def ttft_p99_s(self, tier: int) -> float | None:
+        """p99 TTFT for one tier (the per-tier SLO quantity; None without samples)."""
+        return _percentile(self.ttft_s_by_tier.get(tier, []), 0.99)
+
+    def itl_mean_s(self, tier: int) -> float | None:
+        samples = self.itl_s_by_tier.get(tier, [])
+        if not samples:
+            return None
+        return sum(samples) / len(samples)
+
+
+def _percentile(samples: list[float], q: float) -> float | None:
+    """Nearest-rank percentile (deterministic, no interpolation — bench-stable)."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    rank = min(len(ordered) - 1, max(0, int(-(-q * len(ordered) // 1)) - 1))
+    return ordered[rank]
+
+
+@dataclass
+class _ResumeState:
+    """Decode context captured at preemption: what it takes to continue the request
+    token-for-token. ``next_token`` is the last emitted (not yet cache-written) token
+    the next decode step feeds; ``rng`` the per-slot carry; ``resident`` how many
+    sequence positions were written to the KV pool. ``swapped`` means the page bytes
+    are parked in the host swap pool (restore = byte copy); otherwise the prefix
+    ``(prompt + tokens)[:resident]`` is recomputed through the radix cache."""
+
+    next_token: int
+    rng: Any  # np [2] uint32 PRNG carry
+    resident: int
+    swapped: bool
+
 
 @dataclass
 class _PrefillTask:
-    """A slot whose prompt is still being computed (chunked prefill in flight)."""
+    """A slot whose prefix is still being computed (chunked prefill in flight).
+
+    ``prefill_ids`` is the token span the chunks must compute: the prompt for a fresh
+    request, ``(prompt + generated)[:resident]`` for a drop-and-recompute resume (whose
+    final chunk then restores decode state instead of sampling a first token)."""
 
     state: RequestState
     encoded: tuple  # (do_sample, temperature, top_k, top_p) dense encoding
-    pos: int  # next prompt position to compute (prefix-cache hits start it past 0)
+    pos: int  # next prefill position to compute (prefix-cache hits start it past 0)
+    prefill_ids: list[int]
+    resume: _ResumeState | None = None
 
 
 class ServingEngine:
@@ -177,6 +233,27 @@ class ServingEngine:
             slot count against the same budget (`Scheduler.prefill_budget`).
         prefix_caching: keep finished requests' page-aligned prefixes resident and share
             them with matching future prompts (paged mode only).
+        preemption: what happens to a low-tier slot when a higher-tier request cannot
+            admit, or when an oversubscribed pool runs physically dry: ``"off"`` (never
+            evict — the classic reserve-everything engine), ``"swap"`` (park the
+            victim's KV pages in a host-memory pool through one jitted gather/scatter
+            pair and restore them byte-identical on resume), or ``"recompute"``
+            (release the pages — registered in the radix prefix cache first, so resume
+            is usually a cheap re-attach — and rebuild the slot through chunked
+            prefill). Either way a resumed request continues token-for-token identical
+            to an unpreempted run. Paged mode only.
+        oversubscribe_ratio: admission may promise up to ``ratio * allocatable`` pages
+            (>= 1.0). Worst-case reservations strand capacity — most requests finish
+            well short of ``prompt + max_new`` — so oversubscribing admits more
+            concurrent work; preemption makes the physical shortfall safe, hence
+            ``ratio > 1`` requires ``preemption != "off"``.
+        session_ttl_s: multi-turn retention window. A finished request with a
+            ``session_id`` pins its prefix pages (exempt from LRU eviction) until the
+            session goes idle for this long; each new turn refreshes the TTL.
+        tier_slos: per-priority-tier latency targets
+            (:class:`~dolomite_engine_tpu.serving.scheduler.TierSLO`): the TTFT target
+            orders the chunked-prefill budget (least headroom first) and both targets
+            are reported next to the measured per-tier latencies in serving telemetry.
         speculate_ngram: n-gram / prompt-lookup self-drafting — propose up to `draft_k`
             tokens per slot by matching the slot's recent suffix against its own
             prompt+generation history (host-side, no extra model).
@@ -223,6 +300,10 @@ class ServingEngine:
         kv_dtype: str | None = None,
         prefill_chunk_tokens: int = 512,
         prefix_caching: bool = True,
+        preemption: str = "off",
+        oversubscribe_ratio: float = 1.0,
+        session_ttl_s: float = 300.0,
+        tier_slos: dict[int, TierSLO] | None = None,
         speculate_ngram: bool = False,
         draft_model: Any = None,
         draft_params: Any = None,
@@ -244,6 +325,29 @@ class ServingEngine:
             raise ValueError("kv_dtype (quantized/low-bit KV) requires the paged KV pool")
         if prefill_only and (speculate_ngram or draft_model is not None):
             raise ValueError("prefill_only workers do not decode, so cannot speculate")
+        if preemption not in ("off", "swap", "recompute"):
+            raise ValueError(
+                f"preemption must be 'off', 'swap', or 'recompute', got {preemption!r}"
+            )
+        if preemption != "off" and not paged:
+            raise ValueError("preemption requires the paged KV pool")
+        if preemption != "off" and prefill_only:
+            raise ValueError(
+                "prefill_only workers park finished prefills for handoff and never "
+                "contend on decode pages; run them with preemption='off'"
+            )
+        if oversubscribe_ratio < 1.0:
+            raise ValueError(
+                f"oversubscribe_ratio must be >= 1.0, got {oversubscribe_ratio}"
+            )
+        if oversubscribe_ratio > 1.0 and preemption == "off":
+            raise ValueError(
+                "oversubscribe_ratio > 1.0 reserves pages that are not physically "
+                "backed; that is only safe with preemption enabled ('swap' or "
+                "'recompute')"
+            )
+        if session_ttl_s <= 0:
+            raise ValueError(f"session_ttl_s must be positive, got {session_ttl_s}")
         if prefill_bucket_multiple <= 0 or prefill_bucket_multiple % 8 != 0:
             raise ValueError(
                 f"prefill_bucket_multiple must be a positive multiple of 8, got "
@@ -278,17 +382,22 @@ class ServingEngine:
         # until a DecodeWorker adopts their KV (serving/cluster/disagg.py)
         self._ready_handoffs: list[RequestState] = []
 
+        self.preemption = preemption
+        self.session_ttl_s = session_ttl_s
         if paged:
             self.pool: Any = PagedKVCachePool(
                 model, num_slots, max_len, page_size, num_pages, cache_dtype, mesh=mesh,
-                kv_dtype=kv_dtype,
+                kv_dtype=kv_dtype, oversubscribe_ratio=oversubscribe_ratio,
             )
             self.prefix = PrefixCache(page_size) if prefix_caching else None
+            self._swap = HostSwapPool(self.pool) if preemption == "swap" else None
         else:
             self.pool = SlotKVCachePool(model, num_slots, max_len, cache_dtype, mesh=mesh)
             self.prefix = None
+            self._swap = None
         self.scheduler = Scheduler(
-            max_waiting=max_waiting, clock=clock, prefill_chunk_tokens=prefill_chunk_tokens
+            max_waiting=max_waiting, clock=clock, prefill_chunk_tokens=prefill_chunk_tokens,
+            tier_slos=tier_slos,
         )
         self.stats = EngineStats()
         self._step_count = 0
@@ -524,14 +633,21 @@ class ServingEngine:
         on_token=None,
         on_finish=None,
         rng: jax.Array | None = None,
+        priority: int = 0,
+        session_id: str | None = None,
     ) -> RequestState:
-        """Enqueue a request (FCFS). Raises QueueFullError at the queue bound and
-        ValueError when the request cannot fit a slot."""
+        """Enqueue a request (tier-then-FCFS; ``priority`` 0 is the top tier). A
+        ``session_id`` marks the request as one turn of a conversation: its prefix
+        pages are pinned against LRU eviction until the session's TTL lapses, so the
+        next turn re-attaches instead of re-prefilling. Raises QueueFullError at the
+        queue bound and ValueError when the request cannot fit a slot."""
         prompt_ids = list(map(int, prompt_ids))
         if not prompt_ids:
             raise ValueError("empty prompt")
         if max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens must be positive, got {max_new_tokens}")
+        if priority < 0:
+            raise ValueError(f"priority must be >= 0 (0 is the top tier), got {priority}")
         if len(prompt_ids) + max_new_tokens > self.pool.max_len:
             raise ValueError(
                 f"request needs {len(prompt_ids)} prompt + {max_new_tokens} new tokens "
@@ -555,6 +671,8 @@ class ServingEngine:
             deadline_s=deadline_s,
             on_token=on_token,
             on_finish=on_finish,
+            priority=int(priority),
+            session_id=session_id,
         )
         try:
             state = self.scheduler.submit(request)
@@ -639,6 +757,12 @@ class ServingEngine:
         """Compiled draft-model step variants (0 without a draft model, else 1)."""
         return 0 if self._draft is None else self._draft.draft_compiles
 
+    @property
+    def chunk_compiles(self) -> int:
+        """Total compiled chunk-prefill variants across all (width, samples) buckets —
+        preempt/resume churn must not grow this once the buckets are warm."""
+        return sum(int(fn._cache_size()) for fn in self._chunk_fns.values())
+
     # ------------------------------------------------------------------ dense internals
 
     def _admit(self) -> None:
@@ -678,8 +802,7 @@ class ServingEngine:
         first_token = int(token)  # host fetch: forces completion, ends the TTFT clock
         self.stats.prefill_seconds += time.perf_counter() - t0
         self.stats.prefill_tokens += prompt_len
-        self.stats.admitted += 1
-        get_telemetry().count("serving_requests_admitted")
+        self._count_admission(state, session_hit=False)
         get_telemetry().count("serving_prefill_tokens", prompt_len)
 
         state.slot = slot
@@ -687,6 +810,7 @@ class ServingEngine:
         state.first_token_t = self.scheduler.clock()
         if state.ttft_s is not None:
             self.stats.ttft_s.append(state.ttft_s)
+            self.stats.ttft_s_by_tier.setdefault(request.priority, []).append(state.ttft_s)
         self._slot_states[slot] = state
         self._tokens[slot] = first_token
         self._rngs[slot] = np.array(carry)
@@ -724,111 +848,342 @@ class ServingEngine:
     # ------------------------------------------------------------------ paged internals
 
     def _admit_paged(self) -> None:
-        """Admit FCFS while slot rows AND pages are available. Worst-case pages
-        (minus prefix-cache hits) are reserved up front so a mid-decode page allocation
-        can never fail; prefix-cache-only pages are evicted LRU to make room."""
-        while self.pool.num_free > 0:
+        """Admit tier-then-FCFS while slot rows AND (possibly oversubscribed) pages are
+        available. Worst-case pages (minus prefix-cache hits) are reserved up front;
+        prefix-cache-only pages are evicted LRU to make room. When the head cannot fit
+        and preemption is on, strictly-lower-tier running slots are evicted (swap or
+        drop-and-recompute) until it does — a blocked head still blocks its own and
+        lower tiers (no skip-ahead), but never a higher tier (per-tier queues)."""
+        if self.prefix is not None:
+            self.prefix.expire_sessions(self.scheduler.clock())
+        while True:
             state = self.scheduler.pop_next()
             if state is None:
                 return
             if self.scheduler.expired(state):
                 self._finish(state, RequestStatus.cancelled)
                 continue
-            request = state.request
-            prompt_len = len(request.prompt_ids)
-            page_size = self.pool.page_size
-            worst_pages = -(-(prompt_len + request.max_new_tokens) // page_size)
-            if self.prefix is not None:
-                match = self.prefix.match(request.prompt_ids)
-            else:
-                match = PrefixMatch(nodes=[], cow=None, cow_len=0, resume_pos=0)
-            # attach the hit pages FIRST (refcount 2: index + slot) and pin the COW donor,
-            # so the eviction pass below can never reclaim the pages we are about to use
-            slot = self.pool.allocate()
-            for i, node in enumerate(match.nodes):
-                self.pool.attach_shared(slot, i, node.page)
-            if match.cow is not None:
-                self.pool.incref(match.cow.page)
-
-            needed = worst_pages - len(match.nodes)
-            shortfall = needed - self.pool.available_pages
-            if shortfall > 0 and self.prefix is not None:
-                self.prefix.evict(shortfall, self.pool)
-            if needed > self.pool.available_pages:
-                # not enough pages yet: roll back (free decrefs the attached hit pages)
-                # and wait at the queue head — FCFS, requests never skip ahead
-                if match.cow is not None:
-                    self.pool.decref(match.cow.page)
-                self.pool.free(slot)
+            if self._try_admit(state):
+                continue
+            # blocked: evict strictly-lower-tier victims, one at a time, until the head
+            # fits or no such victim remains (then it waits at its tier's head)
+            admitted = False
+            while self.preemption != "off":
+                victim = self._pick_victim(below_tier=state.request.priority)
+                if victim is None:
+                    break
+                self._preempt(victim)
+                if self._try_admit(state):
+                    admitted = True
+                    break
+            if not admitted:
                 self.scheduler.push_front(state)
                 return
 
-            self.pool.reserve(slot, needed)
+    def _try_admit(self, state: RequestState) -> bool:
+        """One admission attempt: claim a slot, reserve pages, set up the prefill task
+        (or restore a swapped-out victim). Rolls back and returns False when slot rows
+        or pages are short — the caller decides between waiting and preempting."""
+        if self.pool.num_free == 0:
+            return False
+        if state.resume is not None and state.resume.swapped:
+            return self._try_restore_swapped(state)
+        request = state.request
+        resume = state.resume
+        # drop-and-recompute resume: re-run prefill over the already-emitted prefix
+        # (token budget and worst-case pages are unchanged — the sequence is the same)
+        prefill_ids = (
+            (request.prompt_ids + state.tokens)[: resume.resident]
+            if resume is not None
+            else request.prompt_ids
+        )
+        page_size = self.pool.page_size
+        worst_pages = -(-(len(request.prompt_ids) + request.max_new_tokens) // page_size)
+        if self.prefix is not None:
+            match = self.prefix.match(prefill_ids)
+        else:
+            match = PrefixMatch(nodes=[], cow=None, cow_len=0, resume_pos=0)
+        # attach the hit pages FIRST (refcount 2: index + slot) and pin the COW donor,
+        # so the eviction pass below can never reclaim the pages we are about to use
+        slot = self.pool.allocate()
+        for i, node in enumerate(match.nodes):
+            self.pool.attach_shared(slot, i, node.page)
+        if match.cow is not None:
+            self.pool.incref(match.cow.page)
+
+        needed = worst_pages - len(match.nodes)
+        shortfall = needed - self.pool.available_pages
+        if shortfall > 0 and self.prefix is not None:
+            self.prefix.evict(shortfall, self.pool)
+        if needed > self.pool.available_pages:
+            # not enough pages yet: roll back (free decrefs the attached hit pages)
             if match.cow is not None:
-                # copy-on-write at page granularity: the partially matching tail page is
-                # device-copied into a private page; the miss suffix is recomputed over it
-                dst = self.pool.alloc_page(slot, len(match.nodes))
-                self.pool.copy_page(match.cow.page, dst)
                 self.pool.decref(match.cow.page)
+            self.pool.free(slot)
+            return False
 
-            do_sample, temperature, top_k, top_p = request.sampling.encoded()
-            state.slot = slot
-            state.status = RequestStatus.running
-            self._slot_states[slot] = state
-            self._do_sample[slot] = do_sample
-            self._temperature[slot] = temperature
-            self._top_k[slot] = top_k
-            self._top_p[slot] = top_p
-            self._prefill_tasks[slot] = _PrefillTask(
-                state=state,
-                encoded=(do_sample, temperature, top_k, top_p),
-                pos=match.resume_pos,
+        self.pool.reserve(slot, needed)
+        if match.cow is not None:
+            # copy-on-write at page granularity: the partially matching tail page is
+            # device-copied into a private page; the miss suffix is recomputed over it
+            dst = self._alloc_page_reclaiming(slot, len(match.nodes))
+            self.pool.copy_page(match.cow.page, dst)
+            self.pool.decref(match.cow.page)
+
+        do_sample, temperature, top_k, top_p = request.sampling.encoded()
+        state.slot = slot
+        state.status = RequestStatus.running
+        self._slot_states[slot] = state
+        self._do_sample[slot] = do_sample
+        self._temperature[slot] = temperature
+        self._top_k[slot] = top_k
+        self._top_p[slot] = top_p
+        self._prefill_tasks[slot] = _PrefillTask(
+            state=state,
+            encoded=(do_sample, temperature, top_k, top_p),
+            pos=match.resume_pos,
+            prefill_ids=prefill_ids,
+            resume=resume,
+        )
+        self._prefill_order.append(slot)
+
+        hit = match.resume_pos
+        self.stats.prefix_hit_tokens += hit
+        self.stats.prefix_miss_tokens += len(prefill_ids) - hit
+        if hit:
+            get_telemetry().count("serving_prefix_hit_tokens", hit)
+        get_telemetry().count("serving_prefix_miss_tokens", len(prefill_ids) - hit)
+        if resume is None:
+            self._count_admission(state, session_hit=hit > 0)
+        return True
+
+    def _try_restore_swapped(self, state: RequestState) -> bool:
+        """Re-admit a swap-preempted request: its page bytes come back from the host
+        pool into freshly allocated private pages, decode state is reinstalled, and the
+        request continues exactly where it stopped — no prefill, no resampling."""
+        request = state.request
+        resume = state.resume
+        page_size = self.pool.page_size
+        used = -(-resume.resident // page_size)
+        worst_pages = -(-(len(request.prompt_ids) + request.max_new_tokens) // page_size)
+        if worst_pages > self.pool.available_pages:
+            shortfall = worst_pages - self.pool.available_pages
+            if self.prefix is None or not self.prefix.evict(shortfall, self.pool):
+                return False
+            if worst_pages > self.pool.available_pages:
+                return False
+        # the `used` restored pages must exist PHYSICALLY right now (the rest of the
+        # reservation materializes later, covered by reclamation-at-allocation)
+        if self.pool.physical_free < used:
+            if self.prefix is not None:
+                self.prefix.evict(used - self.pool.physical_free, self.pool)
+            if self.pool.physical_free < used:
+                return False
+        slot = self.pool.allocate()
+        self.pool.reserve(slot, worst_pages)
+        pages = [self.pool.alloc_page(slot, i) for i in range(used)]
+        moved = self._swap.swap_in(request.request_id, pages)
+        self.pool.lengths[slot] = resume.resident
+
+        do_sample, temperature, top_k, top_p = request.sampling.encoded()
+        state.slot = slot
+        state.status = RequestStatus.running
+        state.resume = None
+        self._slot_states[slot] = state
+        self._tokens[slot] = resume.next_token
+        self._rngs[slot] = np.asarray(resume.rng)
+        self._do_sample[slot] = do_sample
+        self._temperature[slot] = temperature
+        self._top_k[slot] = top_k
+        self._top_p[slot] = top_p
+        if self.speculating:
+            self._spec_start(slot, request.prompt_ids + state.tokens)
+        self.stats.pages_swapped_in += moved
+        get_telemetry().count("serving_pages_swapped_in", moved)
+        return True
+
+    def _count_admission(self, state: RequestState, session_hit: bool) -> None:
+        """First-admission accounting (resumes don't re-count): admitted counters,
+        per-tier breakdown, and session touch/hit tracking."""
+        request = state.request
+        tier = request.priority
+        self.stats.admitted += 1
+        self.stats.admitted_by_tier[tier] = self.stats.admitted_by_tier.get(tier, 0) + 1
+        get_telemetry().count("serving_requests_admitted")
+        if request.session_id is not None and self.prefix is not None:
+            live = self.prefix.touch_session(
+                request.session_id, self.scheduler.clock(), self.session_ttl_s
             )
-            self._prefill_order.append(slot)
+            if live and session_hit:
+                self.stats.session_hits += 1
+                get_telemetry().count("serving_session_hits")
 
-            hit = match.resume_pos
-            self.stats.prefix_hit_tokens += hit
-            self.stats.prefix_miss_tokens += prompt_len - hit
-            self.stats.admitted += 1
-            get_telemetry().count("serving_requests_admitted")
-            if hit:
-                get_telemetry().count("serving_prefix_hit_tokens", hit)
-            get_telemetry().count("serving_prefix_miss_tokens", prompt_len - hit)
+    # --------------------------------------------------------------- preemption
+
+    def _pick_victim(
+        self, below_tier: int | None = None, exclude: set[int] | None = None
+    ) -> RequestState | None:
+        """The next slot to evict: lowest priority first (highest tier number), most
+        recent arrival within a tier (LIFO — the request with the least sunk service).
+        `below_tier` restricts to strictly lower tiers than the beneficiary (admission
+        preemption never evicts its own tier); `exclude` protects slots mid-allocation.
+        Parked handoffs are never victims (their pages belong to an in-flight transfer).
+        """
+        parked = {state.slot for state in self._ready_handoffs}
+        candidates = [
+            state
+            for slot, state in self._slot_states.items()
+            if slot not in (exclude or ())
+            and slot not in parked
+            and (below_tier is None or state.request.priority > below_tier)
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda state: (state.request.priority, state.seq))
+
+    def _preempt(self, state: RequestState) -> None:
+        """Evict a running slot and re-enqueue its request at its stable FCFS position.
+        Swap mode parks the page bytes host-side (byte-identical restore); recompute
+        mode registers the pages in the prefix cache (usually a free re-attach on
+        resume, a cheap chunked recompute if evicted meanwhile) and releases them. A
+        slot still mid-prefill just restarts its prefill — no decode state exists yet."""
+        slot = state.slot
+        assert slot is not None and self._slot_states.get(slot) is state
+        task = self._prefill_tasks.pop(slot, None)
+        if slot in self._prefill_order:
+            self._prefill_order.remove(slot)
+        if self.speculating:
+            self._spec_stop(slot)
+        if task is not None:
+            # mid-prefill: keep what the chunks already computed by indexing the full
+            # pages below the progress frontier, then restart the (cheap, prefix-hit)
+            # prefill from scratch; decode state was never installed
+            if self.prefix is not None and task.pos >= self.pool.page_size:
+                full = (task.pos // self.pool.page_size) * self.pool.page_size
+                self.prefix.register(
+                    task.prefill_ids[:full],
+                    [int(p) for p in self.pool.page_table[slot]],
+                    self.pool,
+                )
+            state.resume = task.resume  # a preempted resume stays a resume
+        else:
+            resident = int(self.pool.lengths[slot])
+            if self.preemption == "swap":
+                used = -(-resident // self.pool.page_size)
+                pages = [int(p) for p in self.pool.page_table[slot, :used]]
+                moved = self._swap.swap_out(state.request.request_id, pages)
+                self.stats.pages_swapped_out += moved
+                get_telemetry().count("serving_pages_swapped_out", moved)
+                swapped = True
+            else:
+                if self.prefix is not None:
+                    self._register_prefix(state, slot)
+                swapped = False
+            state.resume = _ResumeState(
+                next_token=int(self._tokens[slot]),
+                rng=self._rngs[slot].copy(),
+                resident=resident,
+                swapped=swapped,
+            )
+        self.pool.free(slot)
+        del self._slot_states[slot]
+        state.slot = None
+        state.status = RequestStatus.waiting
+        state.preemptions += 1
+        tier = state.request.priority
+        self.stats.preemptions += 1
+        self.stats.preempted_by_tier[tier] = self.stats.preempted_by_tier.get(tier, 0) + 1
+        get_telemetry().count("serving_preemptions")
+        self.scheduler.push_front(state)
+
+    def _alloc_page_reclaiming(self, slot: int, index: int) -> int:
+        """`alloc_page` that survives an oversubscribed pool running physically dry:
+        reclaim (prefix-LRU eviction, then preemption, then pinned-session eviction as
+        the last resort) until a page is actually free, then map it."""
+        if self.pool.physical_free == 0:
+            self._reclaim_physical(1, protect=slot)
+        return self.pool.alloc_page(slot, index)
+
+    def _reclaim_physical(self, need: int, protect: int | None) -> None:
+        """Free at least `need` physical pages: evict unpinned prefix-cache leaves,
+        preempt the lowest-priority victim (whose recompute-registered pages become
+        evictable in turn), and only as a last resort evict session-pinned pages. The
+        `protect` slot (the one being allocated for) is never preempted, so the oldest
+        highest-priority request always makes progress and the loop terminates."""
+        while self.pool.physical_free < need:
+            if self.prefix is not None:
+                self.prefix.evict(need - self.pool.physical_free, self.pool)
+                if self.pool.physical_free >= need:
+                    return
+            victim = None
+            if self.preemption != "off":
+                victim = self._pick_victim(exclude={protect} if protect is not None else None)
+            if victim is not None:
+                self._preempt(victim)
+                continue
+            if self.prefix is not None and self.prefix.evict(
+                need - self.pool.physical_free, self.pool, include_pinned=True
+            ):
+                continue
+            raise RuntimeError(
+                f"cannot reclaim {need} KV page(s): no evictable prefix pages and no "
+                f"preemptable slots (preemption={self.preemption!r})"
+            )
+
+    def _prefill_priority_key(self, slot: int, now: float):
+        """Chunked-prefill budget order: tier first, then TTFT-SLO headroom (least
+        first — a tier with a target spends its budget where it is closest to missing),
+        then FCFS. Tiers without a target order purely tier-then-FCFS."""
+        state = self._prefill_tasks[slot].state
+        headroom = self.scheduler.ttft_headroom(state, now)
+        return (
+            state.request.priority,
+            float("inf") if headroom is None else headroom,
+            state.seq,
+        )
 
     def _run_prefill_chunks(self, budget: int | None = None) -> None:
-        """Advance in-flight prefills FCFS, spending at most `budget` REAL prompt tokens
-        this step (default: the scheduler's `prefill_chunk_tokens`; the engine step
-        passes `Scheduler.prefill_budget`, which nets out decode's verified tokens) —
-        decode for already-running slots resumes right after, so their ITL stays bounded
-        no matter how long the arriving prompt is."""
+        """Advance in-flight prefills in tier-then-SLO-headroom-then-FCFS order,
+        spending at most `budget` REAL prefix tokens this step (default: the
+        scheduler's `prefill_chunk_tokens`; the engine step passes
+        `Scheduler.prefill_budget`, which nets out decode's verified tokens) — decode
+        for already-running slots resumes right after, so their ITL stays bounded no
+        matter how long the arriving prompt is."""
         if budget is None:
             budget = self.scheduler.prefill_chunk_tokens
         page_size = self.pool.page_size
         view_len = self.pool.max_pages_per_slot * page_size
         while budget > 0 and self._prefill_order:
-            slot = self._prefill_order[0]
+            now = self.scheduler.clock()
+            slot = min(self._prefill_order, key=lambda s: self._prefill_priority_key(s, now))
             task = self._prefill_tasks[slot]
             state = task.state
-            prompt = state.request.prompt_ids
-            prompt_len = len(prompt)
-            take = min(prompt_len - task.pos, budget)
-            final = task.pos + take == prompt_len
+            prefill_ids = task.prefill_ids
+            prefill_len = len(prefill_ids)
+            take = min(prefill_len - task.pos, budget)
+            final = task.pos + take == prefill_len
+            # a resume's final chunk only recomputes K/V — decode state is restored
+            # from the preemption context, never resampled
+            samples = final and task.resume is None
             multiple = self.prefill_bucket_multiple
             width = -(-take // multiple) * multiple
 
             # map fresh pages under the chunk's real positions before the device write
+            # (reclaiming first if the oversubscribed pool ran physically dry)
             for index in range(task.pos // page_size, (task.pos + take - 1) // page_size + 1):
                 if self.pool.page_table[slot, index] == TRASH_PAGE:
-                    self.pool.alloc_page(slot, index)
+                    self._alloc_page_reclaiming(slot, index)
+            if self._slot_states.get(slot) is not state:
+                continue  # reclamation preempted this very task; re-pick
 
             ids = np.full((1, width), self.pad_token_id, np.int32)
-            ids[0, :take] = prompt[task.pos : task.pos + take]
+            ids[0, :take] = prefill_ids[task.pos : task.pos + take]
             mask = np.zeros((1, view_len), np.int32)
             mask[0, : task.pos + take] = 1  # resident prefix + this chunk's real tokens
 
             do_sample, temperature, top_k, top_p = task.encoded
             t0 = time.perf_counter()
-            result = self._get_chunk_fn(width, final)(
+            result = self._get_chunk_fn(width, samples)(
                 self._variables,
                 self.pool.caches,
                 jnp.asarray(self.pool.page_table[slot : slot + 1]),
@@ -842,7 +1197,7 @@ class ServingEngine:
                 jnp.asarray(top_k, jnp.int32),
                 jnp.asarray(top_p, jnp.float32),
             )
-            if final:
+            if samples:
                 self.pool.caches, token, carry = result
                 first_token = int(token)  # host fetch: ends the TTFT clock
             else:
@@ -854,37 +1209,57 @@ class ServingEngine:
             task.pos += take
             budget -= take
 
-            if final:
-                self.pool.lengths[slot] = prompt_len
-                state.first_token_t = self.scheduler.clock()
-                if state.ttft_s is not None:
-                    self.stats.ttft_s.append(state.ttft_s)
-                self._tokens[slot] = first_token
-                self._rngs[slot] = np.array(carry)
-                self._prefill_order.pop(0)
-                del self._prefill_tasks[slot]
+            if not final:
+                continue
+            self.pool.lengths[slot] = prefill_len
+            self._prefill_order.remove(slot)
+            del self._prefill_tasks[slot]
+            if task.resume is not None:
+                # recompute-resume complete: reinstall the captured decode context —
+                # same next token, same rng carry — and continue token-for-token
+                self._tokens[slot] = task.resume.next_token
+                self._rngs[slot] = np.asarray(task.resume.rng)
+                state.resume = None
                 if self.speculating:
-                    self._spec_start(slot, prompt)
-                self._deliver(state, first_token)
-                if self.prefill_only and not state.done:
-                    # park for handoff: the slot (and its pages) stays resident until a
-                    # DecodeWorker adopts the KV and `release_handoff` frees it
-                    self._ready_handoffs.append(state)
+                    self._spec_start(slot, state.request.prompt_ids + state.tokens)
+                continue
+            state.first_token_t = self.scheduler.clock()
+            if state.ttft_s is not None:
+                self.stats.ttft_s.append(state.ttft_s)
+                tier = state.request.priority
+                self.stats.ttft_s_by_tier.setdefault(tier, []).append(state.ttft_s)
+            self._tokens[slot] = first_token
+            self._rngs[slot] = np.array(carry)
+            if self.speculating:
+                self._spec_start(slot, prefill_ids)
+            self._deliver(state, first_token)
+            if self.prefill_only and not state.done:
+                # park for handoff: the slot (and its pages) stays resident until a
+                # DecodeWorker adopts the KV and `release_handoff` frees it
+                self._ready_handoffs.append(state)
 
     def _decode_once_paged(self) -> None:
-        decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
         page_size = self.pool.page_size
+        # map the page under each decoding row's write position first: under
+        # oversubscription this can preempt a (lower-priority) decoding slot to
+        # reclaim pages, so membership is re-checked and the views are built after
+        for slot in [s for s in self._slot_states if s not in self._prefill_tasks]:
+            state = self._slot_states.get(slot)
+            if state is None or slot in self._prefill_tasks:
+                continue  # preempted (or re-admitted into prefill) by reclamation
+            index = int(self.pool.lengths[slot]) // page_size
+            if self.pool.page_table[slot, index] == TRASH_PAGE:
+                self._alloc_page_reclaiming(slot, index)
+        decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
+        if not decoding:
+            return
         # per-step table/length views: idle and mid-prefill rows are zeroed so their
         # garbage write lands in the trash page instead of live pages
         table = np.zeros_like(self.pool.page_table)
         lengths = np.zeros(self.pool.num_slots, np.int32)
         for slot in decoding:
-            position = int(self.pool.lengths[slot])
-            index = position // page_size
-            if self.pool.page_table[slot, index] == TRASH_PAGE:
-                self.pool.alloc_page(slot, index)  # reservation makes this infallible
             table[slot] = self.pool.page_table[slot]
-            lengths[slot] = position
+            lengths[slot] = int(self.pool.lengths[slot])
 
         t0 = time.perf_counter()
         caches, next_tokens, new_rngs = self._decode_step(
@@ -954,15 +1329,14 @@ class ServingEngine:
         return drafts, counts
 
     def _verify_once_paged(self) -> None:
-        decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
-        k = self.draft_k
-        drafts, num_drafts = self._collect_drafts(decoding)
-
         page_size = self.pool.page_size
-        table = np.zeros_like(self.pool.page_table)
-        lengths = np.zeros(self.pool.num_slots, np.int32)
-        for slot in decoding:
-            state = self._slot_states[slot]
+        k = self.draft_k
+        # map pages under each row's verify window first (reclaiming can preempt a
+        # lower-priority row mid-pass, so membership is re-checked and views built after)
+        for slot in [s for s in self._slot_states if s not in self._prefill_tasks]:
+            state = self._slot_states.get(slot)
+            if state is None or slot in self._prefill_tasks:
+                continue  # preempted by reclamation
             position = int(self.pool.lengths[slot])
             # map pages under the verify window, capped at the request's worst-case
             # token count (what admission reserved for): the window overhang past it
@@ -971,9 +1345,16 @@ class ServingEngine:
             last = min(position + k, total - 1)
             for index in range(position // page_size, last // page_size + 1):
                 if self.pool.page_table[slot, index] == TRASH_PAGE:
-                    self.pool.alloc_page(slot, index)  # reservation makes this infallible
+                    self._alloc_page_reclaiming(slot, index)
+        decoding = [s for s in self._slot_states if s not in self._prefill_tasks]
+        if not decoding:
+            return
+        drafts, num_drafts = self._collect_drafts(decoding)
+        table = np.zeros_like(self.pool.page_table)
+        lengths = np.zeros(self.pool.num_slots, np.int32)
+        for slot in decoding:
             table[slot] = self.pool.page_table[slot]
-            lengths[slot] = position
+            lengths[slot] = int(self.pool.lengths[slot])
 
         tokens = np.zeros((self.pool.num_slots, k + 1), np.int32)
         tokens[:, 0] = self._tokens
@@ -1118,6 +1499,8 @@ class ServingEngine:
     def _finish(self, state: RequestState, status: RequestStatus) -> None:
         state.status = status
         state.finish_t = self.scheduler.clock()
+        if self._swap is not None:
+            self._swap.drop(state.request.request_id)  # finished while swapped out
         if self._ready_handoffs:
             self._ready_handoffs = [s for s in self._ready_handoffs if s is not state]
         if state.slot is not None:
@@ -1131,26 +1514,39 @@ class ServingEngine:
                 self._spec_stop(slot)
             self.pool.free(slot)
             del self._slot_states[slot]
+        tier = state.request.priority
         if status == RequestStatus.completed:
             self.stats.completed += 1
+            self.stats.completed_by_tier[tier] = (
+                self.stats.completed_by_tier.get(tier, 0) + 1
+            )
             get_telemetry().count("serving_requests_completed")
         else:
             self.stats.cancelled += 1
             get_telemetry().count("serving_requests_cancelled")
+        if state.first_token_t is not None and state.num_generated > 1:
+            itl = (state.finish_t - state.first_token_t) / (state.num_generated - 1)
+            self.stats.itl_s_by_tier.setdefault(tier, []).append(itl)
         if state.request.on_finish is not None:
             state.request.on_finish(state)
 
     def _register_prefix(self, state: RequestState, slot: int) -> None:
         """Index the slot's full pages before they are released: generated tokens are
-        registered too, so a multi-turn follow-up whose prompt embeds this reply hits."""
+        registered too, so a multi-turn follow-up whose prompt embeds this reply hits.
+        A request with a session id additionally pins the chain until the session's TTL
+        lapses — the conversation's next turn re-attaches even under LRU pressure."""
         written = int(self.pool.lengths[slot])
         if written <= 0:
             return  # cancelled mid-prefill: nothing committed
         prompt = state.request.prompt_ids
-        resident = prompt + state.tokens[: written - len(prompt)]
+        resident = (prompt + state.tokens[: written - len(prompt)])[:written]
         self.prefix.register(
-            resident[:written], [int(p) for p in self.pool.page_table[slot]], self.pool
+            resident, [int(p) for p in self.pool.page_table[slot]], self.pool
         )
+        if state.request.session_id is not None:
+            self.prefix.pin_session(
+                state.request.session_id, resident, self.scheduler.clock(), self.session_ttl_s
+            )
 
     # ------------------------------------------------------ disaggregation (cluster/)
 
@@ -1269,6 +1665,38 @@ class ServingEngine:
             accepted_per_step = 0.0 if per_step is None else round(per_step, 3)
             telemetry.gauge("serving/accept_rate", accept_rate)
             telemetry.gauge("serving/accepted_tokens_per_step", accepted_per_step)
+        # contention breakdown: one entry per tier that has seen traffic or is waiting,
+        # with the measured latencies next to their SLO targets
+        depth_by_tier = self.scheduler.queue_depth_by_tier()
+        tiers: dict[str, dict] = {}
+        for tier in sorted(
+            set(depth_by_tier)
+            | set(stats.admitted_by_tier)
+            | set(stats.ttft_s_by_tier)
+            | set(self.scheduler.tier_slos)
+        ):
+            slo = self.scheduler.slo(tier)
+            p99 = stats.ttft_p99_s(tier)
+            itl = stats.itl_mean_s(tier)
+            tiers[str(tier)] = {
+                "queue_depth": depth_by_tier.get(tier, 0),
+                "admitted": stats.admitted_by_tier.get(tier, 0),
+                "completed": stats.completed_by_tier.get(tier, 0),
+                "preempted": stats.preempted_by_tier.get(tier, 0),
+                "ttft_p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+                "ttft_target_ms": (
+                    None if slo.ttft_target_s is None else round(slo.ttft_target_s * 1e3, 3)
+                ),
+                "itl_mean_ms": None if itl is None else round(itl * 1e3, 3),
+                "itl_target_ms": (
+                    None if slo.itl_target_s is None else round(slo.itl_target_s * 1e3, 3)
+                ),
+            }
+            telemetry.gauge(
+                f"serving/priority_queue_depth/tier{tier}", depth_by_tier.get(tier, 0)
+            )
+            if p99 is not None:
+                telemetry.gauge(f"serving/ttft_p99_ms/tier{tier}", round(p99 * 1e3, 3))
         ttft = stats.mean_ttft_s()
         prefill_rate = stats.prefill_tok_s()
         decode_rate = stats.decode_tok_s()
@@ -1289,6 +1717,12 @@ class ServingEngine:
             decode_tok_s=None if decode_rate is None else round(decode_rate, 1),
             accept_rate=accept_rate,
             accepted_tokens_per_step=accepted_per_step,
+            preemptions=stats.preemptions,
+            pages_swapped_out=stats.pages_swapped_out,
+            pages_swapped_in=stats.pages_swapped_in,
+            session_hits=stats.session_hits,
+            sessions_live=0 if self.prefix is None else self.prefix.sessions_live,
+            tiers=tiers,
             kernels=active_kernel_backends(),
             counters={
                 "admitted": stats.admitted,

@@ -209,10 +209,15 @@ class PagedKVCachePool:
         dtype=None,
         mesh: Mesh | None = None,
         kv_dtype: str | None = None,
+        oversubscribe_ratio: float = 1.0,
     ) -> None:
         assert num_slots > 0 and max_len > 0, (num_slots, max_len)
         if page_size <= 0 or page_size % 8 != 0:
             raise ValueError(f"page_size must be a positive multiple of 8, got {page_size}")
+        if oversubscribe_ratio < 1.0:
+            raise ValueError(
+                f"oversubscribe_ratio must be >= 1.0, got {oversubscribe_ratio}"
+            )
         if kv_dtype is not None and kv_dtype not in KV_DTYPES:
             raise ValueError(
                 f"kv_dtype must be one of {sorted(KV_DTYPES)} (or None for the model/"
@@ -223,6 +228,10 @@ class PagedKVCachePool:
         self.page_size = page_size
         self.kv_dtype = kv_dtype
         self.quantized = kv_dtype in QUANTIZED_KV_DTYPES
+        # admission may promise up to ratio * allocatable pages (reservations beyond the
+        # physical pool are only safe when the ENGINE can preempt to reclaim — validated
+        # there); 1.0 keeps the classic "every reservation is physically backed" invariant
+        self.oversubscribe_ratio = oversubscribe_ratio
         self.max_pages_per_slot = -(-max_len // page_size)
         if num_pages is None:
             # dense-parity capacity by default (plus the trash page): the paged pool is
@@ -312,9 +321,20 @@ class PagedKVCachePool:
         return (self.num_pages - 1) - len(self._free_pages)
 
     @property
+    def physical_free(self) -> int:
+        """Pages actually on the free list — what `alloc_page` can hand out RIGHT NOW.
+        Under oversubscription this can be less than the outstanding reservations; the
+        engine reclaims (prefix-evict / preempt) before mapping when it hits zero."""
+        return len(self._free_pages)
+
+    @property
     def available_pages(self) -> int:
-        """Free pages not promised to an admitted slot — what admission may spend."""
-        return len(self._free_pages) - self._total_reserved
+        """Pages admission may still promise: the (possibly oversubscribed) virtual
+        capacity minus pages already referenced and outstanding reservations. With
+        ``oversubscribe_ratio == 1.0`` this reduces to ``free - reserved`` — the classic
+        "every reservation is physically backed" accounting."""
+        virtual = int(self.oversubscribe_ratio * (self.num_pages - 1))
+        return virtual - self.pages_in_use - self._total_reserved
 
     @property
     def page_fragmentation(self) -> float:
@@ -344,9 +364,16 @@ class PagedKVCachePool:
 
     def alloc_page(self, slot: int, index: int) -> int:
         """Map a fresh private page (refcount 1) at logical page slot `index`, consuming
-        one unit of the slot's reservation — which is what makes this infallible."""
+        one unit of the slot's reservation — infallible at ratio 1.0 (reservations are
+        physically backed); an oversubscribed engine must reclaim pages (prefix-evict /
+        preempt) before calling when `physical_free` is 0."""
         assert self.page_table[slot, index] == TRASH_PAGE, (slot, index)
         assert self._slot_reserved[slot] > 0, f"slot {slot} has no reserved pages left"
+        if not self._free_pages:
+            raise RuntimeError(
+                "page pool physically exhausted under oversubscription: the engine must "
+                "reclaim (prefix-evict or preempt) before mapping a page"
+            )
         page = self._free_pages.pop()
         self.refcounts[page] = 1
         self.page_table[slot, index] = page
@@ -391,3 +418,68 @@ def _copy_page(pool_caches: KVCacheList, src, dst) -> KVCacheList:
         {name: array.at[dst].set(array[src]) for name, array in c.items()}
         for c in pool_caches
     ]
+
+
+class HostSwapPool:
+    """Host-memory parking lot for preempted slots' KV pages (``preemption="swap"``).
+
+    Swap-out gathers a victim's physical pages through ONE jitted copy
+    (`ops/attention.gather_kv_pages` — index vectors padded to the pool's
+    ``max_pages_per_slot``, so any request moves through the same compiled program),
+    fetches the chunk to host numpy, and lets the engine free the device pages; swap-in
+    scatters the chunk back onto freshly allocated pages (`scatter_kv_pages`, pool
+    caches donated). The round trip is a raw copy — no arithmetic — so restored page
+    bytes (and a quantized pool's scale rows) are identical to what was swapped out,
+    which is what makes a swap-resumed request trivially token-for-token.
+    """
+
+    def __init__(self, pool: "PagedKVCachePool") -> None:
+        from ..ops.attention import gather_kv_pages, scatter_kv_pages
+
+        self.pool = pool
+        self._gather = jax.jit(gather_kv_pages)
+        self._scatter = jax.jit(scatter_kv_pages, donate_argnums=(0,))
+        # request_id -> (host payload, page count); payloads are per-layer dicts of
+        # [max_pages_per_slot, ...] numpy chunks (pad lanes hold trash-page garbage)
+        self._parked: dict[int, tuple[list[dict[str, np.ndarray]], int]] = {}
+
+    def __len__(self) -> int:
+        return len(self._parked)
+
+    @property
+    def host_bytes(self) -> int:
+        """Resident host memory across every parked payload (telemetry)."""
+        return sum(
+            sum(array.nbytes for chunk in payload for array in chunk.values())
+            for payload, _ in self._parked.values()
+        )
+
+    def swap_out(self, request_id: int, pages: list[int]) -> int:
+        """Snapshot `pages` (chain order) to host under `request_id`. The caller frees
+        the device pages afterwards. Returns the page count."""
+        width = self.pool.max_pages_per_slot
+        assert len(pages) <= width, (pages, width)
+        index = np.full(width, TRASH_PAGE, np.int32)
+        index[: len(pages)] = pages
+        payload = jax.device_get(self._gather(self.pool.caches, jnp.asarray(index)))
+        self._parked[request_id] = (payload, len(pages))
+        return len(pages)
+
+    def swap_in(self, request_id: int, dst_pages: list[int]) -> int:
+        """Restore the parked payload onto `dst_pages` (freshly allocated, chain order)
+        and drop the host copy. Returns the page count."""
+        payload, used = self._parked.pop(request_id)
+        assert len(dst_pages) == used, (dst_pages, used)
+        width = self.pool.max_pages_per_slot
+        index = np.full(width, TRASH_PAGE, np.int32)
+        index[:used] = dst_pages
+        self.pool.caches = self._scatter(
+            self.pool.caches,
+            [{name: jnp.asarray(array) for name, array in chunk.items()} for chunk in payload],
+            jnp.asarray(index),
+        )
+        return used
+
+    def drop(self, request_id: int) -> None:
+        """Discard a parked payload (the request finished or was cancelled while out)."""
+        self._parked.pop(request_id, None)

@@ -18,6 +18,13 @@ owning request finishes. When admission runs short of pages, `evict` releases
 least-recently-used **leaf** entries (children are keyed under their parents, so evicting
 an interior node would orphan reachable state); pages still shared with live slots are
 never reclaimed.
+
+**Sessions** (multi-turn retention): a conversation's chain can be *pinned* under a
+session id (`pin_session`) — pinned nodes are exempt from LRU eviction while the session
+is live, so a follow-up turn hits even under heavy unrelated traffic. Sessions expire on
+a TTL (`expire_sessions`, clock provided by the caller) or refresh on `touch_session`;
+``evict(..., include_pinned=True)`` is the engine's last-resort escape hatch so a pinned
+chain can never wedge page reclamation outright.
 """
 
 from __future__ import annotations
@@ -36,6 +43,15 @@ class PrefixNode:
     children: dict[tuple[int, ...], "PrefixNode"] = field(default_factory=dict)
     last_used: int = 0
     depth: int = 0  # page index within the chain (absolute positions [depth*P, (depth+1)*P))
+    pinned: int = 0  # live sessions holding this node (exempt from LRU while > 0)
+
+
+@dataclass
+class _Session:
+    """One live conversation: the pinned chain of its latest turn + its expiry clock."""
+
+    nodes: list[PrefixNode]
+    expires_at: float
 
 
 @dataclass
@@ -59,13 +75,15 @@ class PrefixMatch:
 
 
 class PrefixCache:
-    """Token-keyed page index with LRU leaf eviction. Pure host bookkeeping — no jax."""
+    """Token-keyed page index with LRU leaf eviction and session pinning. Pure host
+    bookkeeping — no jax; session expiry runs on a caller-supplied clock value."""
 
     def __init__(self, page_size: int) -> None:
         self.page_size = page_size
         self.root = PrefixNode(tokens=(), page=-1, depth=-1)
         self._num_entries = 0
         self._clock = itertools.count(1)
+        self._sessions: dict[str, _Session] = {}
 
     def __len__(self) -> int:
         return self._num_entries
@@ -156,19 +174,78 @@ class PrefixCache:
             cur = child
         return added
 
+    # ------------------------------------------------------------------ sessions
+
+    def pin_session(self, session_id: str, token_ids: list[int], now: float, ttl_s: float) -> int:
+        """Pin the registered chain for `token_ids` under `session_id` until ``now +
+        ttl_s``: the chain's nodes become exempt from LRU eviction while the session is
+        live. Re-pinning the same session (the next turn of the conversation) replaces
+        the pinned chain — pins never stack across turns. Returns #nodes pinned."""
+        chain: list[PrefixNode] = []
+        cur = self.root
+        page = self.page_size
+        for i in range(len(token_ids) // page):
+            child = cur.children.get(tuple(token_ids[i * page : (i + 1) * page]))
+            if child is None:
+                break
+            chain.append(child)
+            cur = child
+        previous = self._sessions.pop(session_id, None)
+        if previous is not None:
+            for node in previous.nodes:
+                node.pinned -= 1
+        for node in chain:
+            node.pinned += 1
+        self._sessions[session_id] = _Session(nodes=chain, expires_at=now + ttl_s)
+        return len(chain)
+
+    def touch_session(self, session_id: str, now: float, ttl_s: float) -> bool:
+        """Refresh a live session's TTL. Returns whether the session was live (an
+        expired or unknown id returns False and stays unpinned — the caller treats the
+        request as a fresh conversation and re-pins on finish)."""
+        session = self._sessions.get(session_id)
+        if session is None:
+            return False
+        if session.expires_at < now:
+            self._expire(session_id)
+            return False
+        session.expires_at = now + ttl_s
+        return True
+
+    def expire_sessions(self, now: float) -> int:
+        """Unpin every session whose TTL lapsed; their pages return to plain LRU order.
+        Returns the number of sessions expired."""
+        lapsed = [sid for sid, s in self._sessions.items() if s.expires_at < now]
+        for sid in lapsed:
+            self._expire(sid)
+        return len(lapsed)
+
+    @property
+    def sessions_live(self) -> int:
+        return len(self._sessions)
+
+    def _expire(self, session_id: str) -> None:
+        session = self._sessions.pop(session_id)
+        for node in session.nodes:
+            node.pinned -= 1
+
     # ------------------------------------------------------------------ eviction
 
-    def evict(self, pages_needed: int, pool) -> int:
+    def evict(self, pages_needed: int, pool, include_pinned: bool = False) -> int:
         """Release index references until `pages_needed` pages came free (or nothing more
         is evictable). Only LRU *leaves* whose page the index alone still references are
         candidates; freeing a leaf can expose its parent, so sweep until a pass frees
-        nothing. Returns the number of pages actually freed."""
+        nothing. Session-pinned nodes are skipped unless ``include_pinned`` — the
+        engine's last resort when every unpinned page is spoken for, so a pinned chain
+        degrades to recompute instead of wedging allocation. Returns pages freed."""
         freed = 0
         while freed < pages_needed:
             candidates = [
                 node
                 for node in self._iter_nodes()
-                if not node.children and pool.refcounts[node.page] == 1
+                if not node.children
+                and pool.refcounts[node.page] == 1
+                and (include_pinned or node.pinned == 0)
             ]
             if not candidates:
                 break

@@ -124,6 +124,17 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         # (emitted tokens per step is this + 1)
         "accept_rate",
         "accepted_tokens_per_step",
+        # contention-aware scheduling (serving/engine.py, docs/SERVING.md "Scheduling
+        # under contention"): cumulative slot evictions, pages moved through the host
+        # swap pool, admissions that reused a live session's pinned prefix, live pinned
+        # sessions, and a per-tier breakdown {tier: {queue_depth, admitted, completed,
+        # preempted, ttft_p99_ms, ttft_target_ms, itl_mean_ms, itl_target_ms}}
+        "preemptions",
+        "pages_swapped_out",
+        "pages_swapped_in",
+        "session_hits",
+        "sessions_live",
+        "tiers",
         # active kernel backend per op family (ops/pallas/config.py) — which lowering
         # produced these serving numbers, for kernel A/B attribution
         "kernels",
@@ -177,6 +188,13 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     # accept rate is accepted / proposed, rendered by tools/telemetry_summary.py
     "serving_draft_tokens_proposed",
     "serving_draft_tokens_accepted",
+    # contention-aware scheduling (serving/engine.py): slots evicted for a higher tier
+    # or for physical pages (swap or drop-and-recompute), KV pages moved out to / back
+    # from the host swap pool, and admissions that reused a live session's pinned prefix
+    "serving_preemptions",
+    "serving_pages_swapped_out",
+    "serving_pages_swapped_in",
+    "serving_session_hits",
     # distributed serving router (serving/cluster/router.py): requests placed on a
     # replica / shed at the fleet-wide admission bound / routed by prefix affinity
     "router_requests_routed",

@@ -1,0 +1,521 @@
+"""Contention-aware scheduling tests: priority tiers, paged-KV preemption (swap and
+drop-and-recompute), oversubscription, and multi-turn session retention.
+
+The load-bearing invariants:
+
+- a preempted-then-resumed request is token-for-token identical to an unpreempted run
+  (greedy bit-exact) with paged + prefix + chunked — and with speculation and quantized
+  kv_dtype active;
+- swap-out/in is a raw byte copy: restored pages (and quantized scale rows) are
+  identical to what was swapped out;
+- the decode/verify/chunk programs never recompile through preempt/resume churn;
+- the scheduler's tier-then-FCFS order is stable: re-enqueued preempted requests do not
+  skip ahead of earlier same-tier arrivals, and never block a higher tier;
+- session-pinned prefix pages survive LRU pressure while the session is live and become
+  evictable once its TTL lapses; routers keep session -> replica affinity.
+
+All model paths are unsharded tiny models (same conventions as tests/test_serving*.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.generation_utils import generate_tokens
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import (
+    Request,
+    RequestStatus,
+    Scheduler,
+    ServingEngine,
+    TierSLO,
+    serve_batch,
+)
+
+from .test_commons import get_dense_test_config
+
+PAGE = 8
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+_REFERENCE_CACHE: dict = {}
+
+
+def _reference(model, params, config, prompt, rng_seed, max_new):
+    """One-shot generate_tokens reference, memoized so parametrized modes sharing a
+    workload don't pay the compile twice (rng is PRNGKey(rng_seed))."""
+    key = (tuple(prompt), rng_seed, max_new)
+    if key not in _REFERENCE_CACHE:
+        ids = jnp.asarray([prompt], jnp.int32)
+        out, _ = generate_tokens(
+            model, params, ids, jnp.ones_like(ids), jax.random.PRNGKey(rng_seed),
+            max_new_tokens=max_new, do_sample=False, eos_token_id=None,
+            pad_token_id=config.pad_token_id,
+        )
+        _REFERENCE_CACHE[key] = [int(t) for t in np.asarray(out[0])]
+    return _REFERENCE_CACHE[key]
+
+
+def _contended_engine(model, config, params, preemption, **overrides):
+    """Pool sized so one low-tier hog fits but a second worst-case request does not —
+    admitting a high-tier request then REQUIRES preemption."""
+    kwargs = dict(
+        num_slots=2,
+        max_len=32,
+        prefill_bucket_multiple=8,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+        page_size=PAGE,
+        num_pages=3 + 1 + 1,  # 3 pages = one hog's worst case, +1 spare, +trash
+        preemption=preemption,
+    )
+    kwargs.update(overrides)
+    return ServingEngine(model, params, **kwargs)
+
+
+# ------------------------------------------------------------------- scheduler ordering
+
+
+def test_scheduler_pops_tier_then_fcfs():
+    scheduler = Scheduler(max_waiting=8)
+    low_a = scheduler.submit(Request(prompt_ids=[1], max_new_tokens=1, priority=2))
+    high = scheduler.submit(Request(prompt_ids=[2], max_new_tokens=1, priority=0))
+    low_b = scheduler.submit(Request(prompt_ids=[3], max_new_tokens=1, priority=2))
+    mid = scheduler.submit(Request(prompt_ids=[4], max_new_tokens=1, priority=1))
+    assert scheduler.queue_depth_by_tier() == {0: 1, 1: 1, 2: 2}
+    assert [scheduler.pop_next() for _ in range(4)] == [high, mid, low_a, low_b]
+    assert scheduler.pop_next() is None
+
+
+def test_scheduler_push_front_is_stable_tier_then_fcfs():
+    """Regression (the PR's small fix): a re-enqueued preempted request must come back
+    at its seq position WITHIN its tier — behind earlier same-tier arrivals, never in
+    front of them (a naive global appendleft put the latest re-enqueue first), and a
+    low-tier re-enqueue must never block a higher-tier head."""
+    scheduler = Scheduler(max_waiting=8)
+    low_a = scheduler.submit(Request(prompt_ids=[1], max_new_tokens=1, priority=2))
+    low_b = scheduler.submit(Request(prompt_ids=[2], max_new_tokens=1, priority=2))
+    assert scheduler.pop_next() is low_a and scheduler.pop_next() is low_b
+    # both "running"; preempt low_a FIRST, then low_b (naive appendleft would now pop
+    # low_b first) — seq order must win
+    scheduler.push_front(low_a)
+    scheduler.push_front(low_b)
+    # a higher-tier arrival AFTER the re-enqueues still pops first
+    high = scheduler.submit(Request(prompt_ids=[3], max_new_tokens=1, priority=0))
+    assert scheduler.pop_next() is high
+    assert scheduler.pop_next() is low_a  # earlier arrival first, not the last re-enqueue
+    assert scheduler.pop_next() is low_b
+    # rollback case: a popped head returns to the exact head, ahead of later arrivals
+    mid_a = scheduler.submit(Request(prompt_ids=[4], max_new_tokens=1, priority=1))
+    mid_b = scheduler.submit(Request(prompt_ids=[5], max_new_tokens=1, priority=1))
+    head = scheduler.pop_next()
+    assert head is mid_a
+    scheduler.push_front(head)
+    assert scheduler.pop_next() is mid_a and scheduler.pop_next() is mid_b
+
+
+def test_scheduler_ttft_headroom():
+    now = [0.0]
+    scheduler = Scheduler(
+        max_waiting=4, clock=lambda: now[0], tier_slos={0: TierSLO(ttft_target_s=2.0)}
+    )
+    tiered = scheduler.submit(Request(prompt_ids=[1], max_new_tokens=1, priority=0))
+    untiered = scheduler.submit(Request(prompt_ids=[2], max_new_tokens=1, priority=1))
+    now[0] = 1.5
+    assert scheduler.ttft_headroom(tiered) == pytest.approx(0.5)
+    assert scheduler.ttft_headroom(untiered) is None  # no target for tier 1
+    now[0] = 3.0
+    assert scheduler.ttft_headroom(tiered) == pytest.approx(-1.0)  # already missed
+
+
+# ------------------------------------------------------------------- preempt -> resume
+
+
+@pytest.mark.parametrize("mode", ["recompute", "swap"])
+def test_preempt_resume_is_greedy_bit_exact(mode):
+    """A high-tier arrival evicts the low-tier hog mid-decode; the hog resumes and both
+    requests finish token-for-token identical to one-shot generate_tokens — with the
+    paged pool, prefix cache, and chunked prefill all active."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(0)
+    engine = _contended_engine(model, config, params, mode)
+    low_prompt, hi_prompt = _random_prompt(rs, config, 10), _random_prompt(rs, config, 12)
+    low_rng, hi_rng = jax.random.PRNGKey(11), jax.random.PRNGKey(12)
+
+    low = engine.submit(prompt_ids=low_prompt, max_new_tokens=12, rng=low_rng, priority=2)
+    for _ in range(4):
+        engine.step()
+    assert low.status == RequestStatus.running
+    hi = engine.submit(prompt_ids=hi_prompt, max_new_tokens=8, rng=hi_rng, priority=0)
+    engine.drain()
+
+    assert low.preemptions >= 1 and low.status == RequestStatus.completed
+    assert hi.status == RequestStatus.completed and hi.preemptions == 0
+    assert engine.stats.preemptions == low.preemptions
+    if mode == "swap":
+        assert engine.stats.pages_swapped_out > 0
+        assert engine.stats.pages_swapped_in == engine.stats.pages_swapped_out
+    assert low.tokens == _reference(model, params, config, low_prompt, 11, 12)
+    assert hi.tokens == _reference(model, params, config, hi_prompt, 12, 8)
+    assert engine.decode_compiles == 1
+    assert engine.pool.num_free == engine.pool.num_slots  # slots reclaimed
+    assert len(engine._swap or []) == 0  # no payload leaked in the host pool
+
+
+def test_admission_preemption_only_evicts_strictly_lower_tiers():
+    """A same-tier arrival must WAIT (FCFS within the tier), not evict."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(3)
+    engine = _contended_engine(model, config, params, "swap")
+    first = engine.submit(
+        prompt_ids=_random_prompt(rs, config, 10), max_new_tokens=12, priority=1
+    )
+    for _ in range(4):
+        engine.step()
+    assert first.status == RequestStatus.running
+    peer = engine.submit(
+        prompt_ids=_random_prompt(rs, config, 12), max_new_tokens=8, priority=1
+    )
+    engine.step()
+    assert first.status == RequestStatus.running and first.preemptions == 0
+    assert peer.status == RequestStatus.waiting
+    engine.drain()
+    assert engine.stats.preemptions == 0
+    assert first.status == peer.status == RequestStatus.completed
+
+
+def test_preempt_resume_with_speculation_and_quantized_kv():
+    """Preemption under ngram speculation + int8 paged KV: the preempted run matches an
+    UNPREEMPTED engine of the same configuration token-for-token (int8 pages are a
+    tolerance-level format, so the engine-vs-engine comparison is the bit-exactness
+    contract), and the verify step still compiles exactly once. Both preemption modes
+    run against one shared baseline."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(5)
+    # repetitive prompt so the n-gram drafter actually proposes
+    phrase = _random_prompt(rs, config, 5)
+    low_prompt = (phrase * 3)[:12]
+    hi_prompt = _random_prompt(rs, config, 12)
+    low_rng, hi_rng = jax.random.PRNGKey(21), jax.random.PRNGKey(22)
+    kwargs = dict(speculate_ngram=True, draft_k=3, kv_dtype="int8")
+
+    def run(mode: str):
+        engine = _contended_engine(
+            model, config, params, mode,
+            **(kwargs if mode != "off" else {**kwargs, "num_pages": 12}),
+        )
+        low = engine.submit(prompt_ids=low_prompt, max_new_tokens=12, rng=low_rng, priority=2)
+        for _ in range(4):
+            engine.step()
+        hi = engine.submit(prompt_ids=hi_prompt, max_new_tokens=8, rng=hi_rng, priority=0)
+        engine.drain()
+        return engine, low, hi
+
+    baseline_engine, low_ref, hi_ref = run("off")
+    assert low_ref.preemptions == 0 and baseline_engine.stats.preemptions == 0
+    for mode in ("recompute", "swap"):
+        engine, low, hi = run(mode)
+        assert low.preemptions >= 1, mode
+        assert low.tokens == low_ref.tokens, mode
+        assert hi.tokens == hi_ref.tokens, mode
+        assert engine.verify_compiles == 1 and engine.decode_compiles == 0
+        assert engine.pool.num_free == engine.pool.num_slots
+
+
+def test_swap_roundtrip_page_and_scale_byte_identity():
+    """Swap-out then swap-in restores page bytes AND quantized scale rows exactly —
+    compared lane-for-lane against a device snapshot taken before preemption."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(7)
+    engine = _contended_engine(model, config, params, "swap", kv_dtype="int8")
+    prompt = _random_prompt(rs, config, 10)
+    state = engine.submit(prompt_ids=prompt, max_new_tokens=12, rng=jax.random.PRNGKey(1), priority=2)
+    for _ in range(5):
+        engine.step()
+    assert state.status == RequestStatus.running
+    slot = state.slot
+    resident = int(engine.pool.lengths[slot])
+    used = -(-resident // PAGE)
+    old_pages = np.asarray(engine.pool.page_table[slot, :used])
+    snapshot = [
+        {name: np.asarray(array[old_pages]) for name, array in cache.items()}
+        for cache in engine.pool.caches
+    ]
+
+    engine._preempt(state)
+    assert state.status == RequestStatus.waiting and state.resume is not None
+    assert state.resume.swapped and state.resume.resident == resident
+    # the host payload is byte-identical to the device snapshot
+    payload, parked = engine._swap._parked[state.request.request_id]
+    assert parked == used
+    for chunk, reference in zip(payload, snapshot):
+        assert set(chunk) == set(reference)
+        for name in reference:
+            np.testing.assert_array_equal(chunk[name][:used], reference[name])
+
+    # resume through the normal admission path, then compare the restored device pages
+    popped = engine.scheduler.pop_next()
+    assert popped is state
+    assert engine._try_admit(state)
+    assert state.status == RequestStatus.running
+    new_pages = np.asarray(engine.pool.page_table[state.slot, :used])
+    assert new_pages.size and all(int(p) != 0 for p in new_pages)
+    for cache, reference in zip(engine.pool.caches, snapshot):
+        for name in reference:
+            np.testing.assert_array_equal(np.asarray(cache[name][new_pages]), reference[name])
+    engine.drain()
+    assert state.status == RequestStatus.completed
+
+
+def test_compile_counts_survive_preemption_churn():
+    """decode_compiles stays 1 and the chunk-fn cache stops growing once warm, through
+    repeated preempt/resume cycles (the acceptance clause on compile invariance)."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(9)
+    engine = _contended_engine(model, config, params, "swap", num_slots=3, oversubscribe_ratio=2.0)
+
+    def churn():
+        specs = [
+            dict(
+                prompt_ids=_random_prompt(rs, config, 8 + 2 * (i % 3)),
+                max_new_tokens=10,
+                priority=i % 3,
+            )
+            for i in range(6)
+        ]
+        serve_batch(engine, specs)
+
+    churn()  # warm every program, including the preempt/resume paths
+    assert engine.stats.preemptions > 0, "workload failed to trigger preemption"
+    warm_chunks = engine.chunk_compiles
+    before = engine.stats.preemptions
+    churn()
+    assert engine.stats.preemptions > before  # more churn actually happened
+    assert engine.decode_compiles == 1
+    assert engine.chunk_compiles == warm_chunks  # no new chunk variants after warmup
+
+
+# ------------------------------------------------------------------- oversubscription
+
+
+def test_oversubscribed_admission_and_reclamation_bit_exact():
+    """ratio 2.0 admits beyond physical pages; decode-time reclamation (prefix evict +
+    preempt) keeps every request correct and the pool accounting clean."""
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(13)
+    engine = ServingEngine(
+        model, params, num_slots=5, max_len=24, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id, page_size=PAGE,
+        num_pages=8, preemption="swap", oversubscribe_ratio=2.0,
+    )
+    # two prompt lengths -> two reference compile buckets; distinct rng per request
+    prompts = [_random_prompt(rs, config, 6 + 2 * (i % 2)) for i in range(8)]
+    states = serve_batch(
+        engine,
+        [
+            dict(prompt_ids=p, max_new_tokens=8, rng=jax.random.PRNGKey(300 + i), priority=i % 2)
+            for i, p in enumerate(prompts)
+        ],
+    )
+    assert engine.stats.peak_active > 2  # more hogs in flight than physically reservable
+    assert engine.stats.preemptions > 0  # the pool really ran physically dry
+    for i, (state, prompt) in enumerate(zip(states, prompts)):
+        assert state.status == RequestStatus.completed
+        if i % 2 == 1:  # the low-tier rows — the ones that get preempted — all checked
+            assert state.tokens == _reference(model, params, config, prompt, 300 + i, 8)
+    assert engine.decode_compiles == 1
+    assert engine.pool.num_free == engine.pool.num_slots
+    assert engine.pool._total_reserved == 0
+
+
+def test_preemption_and_oversubscription_validation():
+    config, model, params = _tiny_model()
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, num_slots=1, max_len=16, preemption="sideways")
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, num_slots=1, max_len=16, paged=False, preemption="swap")
+    with pytest.raises(ValueError):
+        # oversubscription without preemption is unsafe and rejected
+        ServingEngine(model, params, num_slots=1, max_len=16, oversubscribe_ratio=1.5)
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, num_slots=1, max_len=16, oversubscribe_ratio=0.5)
+    with pytest.raises(ValueError):
+        ServingEngine(
+            model, params, num_slots=1, max_len=16, prefill_only=True, preemption="swap"
+        )
+    engine = ServingEngine(model, params, num_slots=1, max_len=16, prefill_bucket_multiple=8)
+    with pytest.raises(ValueError):
+        engine.submit(prompt_ids=[1, 2], max_new_tokens=2, priority=-1)
+
+    from dolomite_engine_tpu.arguments import GenerationParameters
+
+    with pytest.raises(ValueError):
+        GenerationParameters(batch_size=1, max_new_tokens=2, preemption="both")
+    with pytest.raises(ValueError):
+        GenerationParameters(batch_size=1, max_new_tokens=2, oversubscribe_ratio=1.5)
+    with pytest.raises(ValueError):
+        GenerationParameters(
+            batch_size=1, max_new_tokens=2, paged_kv_cache=False, preemption="swap"
+        )
+    with pytest.raises(ValueError):
+        GenerationParameters(batch_size=1, max_new_tokens=2, priority=-1)
+    with pytest.raises(ValueError):
+        GenerationParameters(batch_size=1, max_new_tokens=2, session_ttl_s=0.0)
+    ok = GenerationParameters(
+        batch_size=1, max_new_tokens=2, preemption="recompute", oversubscribe_ratio=1.5
+    )
+    assert ok.oversubscribe_ratio == 1.5
+
+
+# ------------------------------------------------------------------------- sessions
+
+
+def test_session_pinned_pages_survive_lru_pressure_then_expire():
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(17)
+    now = [0.0]
+    engine = ServingEngine(
+        model, params, num_slots=2, max_len=32, prefill_bucket_multiple=8,
+        eos_token_id=None, pad_token_id=config.pad_token_id, page_size=PAGE,
+        num_pages=10, session_ttl_s=60.0, clock=lambda: now[0],
+    )
+    session_prompt = _random_prompt(rs, config, 2 * PAGE)
+    serve_batch(
+        engine,
+        [dict(prompt_ids=session_prompt, max_new_tokens=4, session_id="chat-1")],
+    )
+    assert engine.prefix.probe_len(session_prompt) >= PAGE
+    assert engine.prefix.sessions_live == 1
+
+    def flood():
+        serve_batch(
+            engine,
+            [
+                dict(prompt_ids=_random_prompt(rs, config, 2 * PAGE), max_new_tokens=4)
+                for _ in range(6)
+            ],
+        )
+
+    flood()  # admission evicts LRU prefix pages — the pinned chain must survive
+    assert engine.prefix.probe_len(session_prompt) >= PAGE, "pinned pages were evicted"
+
+    # a live follow-up turn refreshes the TTL and counts a session hit
+    now[0] = 50.0
+    follow_up = session_prompt + _random_prompt(rs, config, 4)
+    serve_batch(
+        engine, [dict(prompt_ids=follow_up, max_new_tokens=4, session_id="chat-1")]
+    )
+    assert engine.stats.session_hits == 1
+
+    # TTL lapse: the pin is released and pressure evicts the chain
+    now[0] = 50.0 + 61.0
+    engine.step()  # session expiry runs at the step boundary
+    assert engine.prefix.sessions_live == 0
+    flood()
+    assert engine.prefix.probe_len(session_prompt) == 0
+
+
+def test_router_session_affinity_e2e():
+    from dolomite_engine_tpu.serving.cluster import EngineReplica, Router, route_batch
+
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(19)
+
+    def build(replica_id):
+        return EngineReplica(
+            replica_id,
+            ServingEngine(
+                model, params, num_slots=2, max_len=48, prefill_bucket_multiple=8,
+                eos_token_id=None, pad_token_id=config.pad_token_id, page_size=PAGE,
+            ),
+        )
+
+    router = Router([build(0), build(1)])
+    turn_one = _random_prompt(rs, config, 2 * PAGE)
+    states = route_batch(
+        router, [dict(prompt_ids=turn_one, max_new_tokens=4, session_id="conv-9")]
+    )
+    first_replica = next(
+        r for r in router.replicas if states[0].request.session_id and
+        r.engine.stats.admitted > 0
+    )
+    # turn 2 embeds turn 1's reply; the session must route back to the same replica
+    # and reuse its pinned prefix pages
+    turn_two = turn_one + states[0].tokens + _random_prompt(rs, config, 4)
+    states2 = route_batch(
+        router, [dict(prompt_ids=turn_two, max_new_tokens=4, session_id="conv-9")]
+    )
+    assert str(states2[0].status) == "completed"
+    assert first_replica.engine.stats.admitted == 2  # same replica served both turns
+    assert router.stats.session_affinity_hits >= 1
+    assert first_replica.engine.stats.prefix_hit_tokens >= PAGE  # pinned pages reused
+    other = next(r for r in router.replicas if r is not first_replica)
+    assert other.engine.stats.admitted == 0
+
+
+# ------------------------------------------------------------------------- telemetry
+
+
+def test_serving_record_carries_contention_fields(tmp_path):
+    import json
+
+    from dolomite_engine_tpu.utils.telemetry import (
+        RECORD_SCHEMA,
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _tiny_model()
+    rs = np.random.RandomState(23)
+    sink = tmp_path / "contention.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engine = _contended_engine(
+            model, config, params, "swap",
+            tier_slos={0: TierSLO(ttft_target_s=2.0, itl_target_s=0.5)},
+        )
+        low = engine.submit(
+            prompt_ids=_random_prompt(rs, config, 10), max_new_tokens=12,
+            priority=2, session_id="sess-7",
+        )
+        for _ in range(4):
+            engine.step()
+        engine.submit(prompt_ids=_random_prompt(rs, config, 12), max_new_tokens=8, priority=0)
+        engine.drain()
+        telemetry.close()
+        assert low.preemptions >= 1
+    finally:
+        uninstall_telemetry()
+
+    records = [json.loads(line) for line in open(sink)]
+    serving = [r for r in records if r["kind"] == "serving"]
+    final = serving[-1]
+    for field in RECORD_SCHEMA["serving"]:
+        assert field in final, field
+    assert final["preemptions"] >= 1
+    assert final["pages_swapped_out"] > 0
+    assert final["pages_swapped_in"] == final["pages_swapped_out"]
+    assert final["sessions_live"] == 1
+    tiers = final["tiers"]
+    assert set(tiers) == {"0", "2"}
+    assert tiers["2"]["preempted"] >= 1
+    assert tiers["0"]["ttft_target_ms"] == 2000.0
+    assert tiers["0"]["ttft_p99_ms"] is not None
+    assert telemetry.counters["serving_preemptions"] >= 1
+    assert telemetry.counters["serving_pages_swapped_out"] > 0
+    # per-tier gauges were written (dynamic names, one per tier seen)
+    assert any(name.startswith("serving/priority_queue_depth/tier") for name in telemetry.gauges)
+    assert any(name.startswith("serving/ttft_p99_ms/tier") for name in telemetry.gauges)

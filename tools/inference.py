@@ -55,6 +55,37 @@ def parse_args() -> argparse.Namespace:
         default=4,
         help="draft tokens proposed per engine step (K >= 1)",
     )
+    p.add_argument(
+        "--priority",
+        type=int,
+        default=0,
+        help="priority tier for these prompts (0 = top tier; tier-then-FCFS)",
+    )
+    p.add_argument(
+        "--preemption",
+        choices=["off", "swap", "recompute"],
+        default="off",
+        help="paged-KV preemption of lower-tier slots (swap = host-side page parking, "
+        "recompute = rebuild via the prefix cache); resumes are token-identical",
+    )
+    p.add_argument(
+        "--oversubscribe-ratio",
+        type=float,
+        default=1.0,
+        help="admit up to ratio x allocatable pages of worst-case reservations "
+        "(> 1 requires --preemption swap|recompute)",
+    )
+    p.add_argument(
+        "--session-id",
+        default=None,
+        help="conversation key: finished turns pin their prefix pages until the TTL lapses",
+    )
+    p.add_argument(
+        "--session-ttl",
+        type=float,
+        default=300.0,
+        help="seconds a session's pinned prefix pages survive without a new turn",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--stream",
@@ -143,6 +174,9 @@ def main() -> None:
         pad_token_id=pad_token_id,
         rng=jax.random.PRNGKey(args.seed),
         kv_dtype=args.kv_dtype,
+        preemption=args.preemption,
+        oversubscribe_ratio=args.oversubscribe_ratio,
+        session_ttl_s=args.session_ttl,
         speculate_ngram=args.speculate_ngram,
         draft_model=draft_model,
         draft_params=draft_params,
@@ -166,6 +200,8 @@ def main() -> None:
             prompt_ids=ids,
             max_new_tokens=args.max_new_tokens,
             sampling=sampling,
+            priority=args.priority,
+            session_id=args.session_id,
             on_token=stream_token if args.stream else None,
         )
         for ids in prompt_ids

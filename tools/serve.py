@@ -72,6 +72,41 @@ def parse_args() -> argparse.Namespace:
         help="disable prefix caching (page-aligned prompt prefix reuse)",
     )
     p.add_argument(
+        "--priority",
+        type=int,
+        default=0,
+        help="priority tier for every request this run submits (0 = top tier; "
+        "admission, prefill budget, and preemption are ordered tier-then-FCFS)",
+    )
+    p.add_argument(
+        "--preemption",
+        choices=["off", "swap", "recompute"],
+        default="off",
+        help="evict lower-tier slots when a higher-tier request cannot admit (or an "
+        "oversubscribed pool runs dry): swap parks KV pages host-side "
+        "(byte-identical restore), recompute rebuilds through the prefix cache; "
+        "resumed requests are token-for-token identical either way",
+    )
+    p.add_argument(
+        "--oversubscribe-ratio",
+        type=float,
+        default=1.0,
+        help="admit up to ratio x allocatable pages of worst-case reservations "
+        "(>= 1.0; > 1 requires --preemption swap|recompute)",
+    )
+    p.add_argument(
+        "--session-id",
+        default=None,
+        help="treat every prompt as one turn of this conversation: finished turns pin "
+        "their prefix pages against LRU eviction until the session TTL lapses",
+    )
+    p.add_argument(
+        "--session-ttl",
+        type=float,
+        default=300.0,
+        help="seconds a session's pinned prefix pages survive without a new turn",
+    )
+    p.add_argument(
         "--speculate-ngram",
         action="store_true",
         help="speculative decoding via n-gram/prompt-lookup self-drafting (no extra "
@@ -212,6 +247,9 @@ def main() -> None:
             kv_dtype=args.kv_dtype,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
             prefix_caching=not args.no_prefix_cache,
+            preemption=args.preemption,
+            oversubscribe_ratio=args.oversubscribe_ratio,
+            session_ttl_s=args.session_ttl,
             speculate_ngram=args.speculate_ngram,
             draft_model=draft_model,
             draft_params=draft_params,
@@ -240,6 +278,8 @@ def main() -> None:
                     speculate_ngram=False,
                     draft_model=None,
                     draft_params=None,
+                    preemption="off",
+                    oversubscribe_ratio=1.0,
                 )
                 replica_engine = DisaggregatedEngine(prefill, [build_engine()])
             else:
@@ -261,6 +301,8 @@ def main() -> None:
             max_new_tokens=args.max_new_tokens,
             sampling=sampling,
             deadline_s=args.deadline_s,
+            priority=args.priority,
+            session_id=args.session_id,
         )
         for ids in prompt_ids
     ]
@@ -342,6 +384,13 @@ def main() -> None:
             f"{0.0 if per_step is None else per_step:.2f} accepted/step, "
             f"verify compiles={engine.verify_compiles})"
         )
+    contention_info = ""
+    if stats.preemptions or args.session_id:
+        contention_info = (
+            f", preemptions={stats.preemptions} "
+            f"(pages swapped {stats.pages_swapped_out} out / {stats.pages_swapped_in} in), "
+            f"session hits={stats.session_hits}"
+        )
     paged_info = ""
     if engine.paged:
         kv_info = f" [{engine.pool.kv_dtype}]" if engine.pool.kv_dtype else ""
@@ -360,7 +409,7 @@ def main() -> None:
         f"decode={'n/a' if decode_rate is None else f'{decode_rate:.0f}'} tok/s, "
         f"decode compiles={engine.decode_compiles}, "
         f"free slots={engine.pool.num_free}/{engine.pool.num_slots}"
-        f"{spec_info}{paged_info}",
+        f"{spec_info}{paged_info}{contention_info}",
         file=sys.stderr,
     )
 

@@ -293,6 +293,39 @@ def summarize(records: list[dict]) -> str:
         lines.append(", ".join(parts))
         lines.append("")
 
+        # contention line: only when the run actually scheduled under contention
+        # (preemptions, sessions, or more than the single default tier)
+        tiers = last.get("tiers") or {}
+        contended = (
+            last.get("preemptions")
+            or last.get("session_hits")
+            or last.get("sessions_live")
+            or len(tiers) > 1
+        )
+        if contended:
+            cparts = [
+                f"contention: {last.get('preemptions', 0)} preemption(s) "
+                f"({last.get('pages_swapped_out', 0)} pages swapped out / "
+                f"{last.get('pages_swapped_in', 0)} in)"
+            ]
+            if last.get("session_hits") or last.get("sessions_live"):
+                cparts.append(
+                    f"session hits {last.get('session_hits', 0)} "
+                    f"({last.get('sessions_live', 0)} live)"
+                )
+            for tier, info in sorted(tiers.items(), key=lambda kv: int(kv[0])):
+                bits = [f"{info.get('completed', 0)}/{info.get('admitted', 0)} done"]
+                if info.get("preempted"):
+                    bits.append(f"{info['preempted']} preempted")
+                if info.get("ttft_p99_ms") is not None:
+                    ttft_bit = f"p99 ttft {info['ttft_p99_ms']:.0f}ms"
+                    if info.get("ttft_target_ms") is not None:
+                        ttft_bit += f" (target {info['ttft_target_ms']:.0f}ms)"
+                    bits.append(ttft_bit)
+                cparts.append(f"tier {tier}: " + " ".join(bits))
+            lines.append(", ".join(cparts))
+            lines.append("")
+
     # ---------------------------------------------------------------- router
     if routers:
         last = routers[-1]  # routed/rejected/affinity are cumulative
