@@ -776,6 +776,14 @@ class GenerationParameters(BaseArgs):
     # prefill/decode disaggregation (serving/cluster/disagg.py): each replica becomes a
     # prefill worker feeding a decode worker through an explicit KV page handoff
     disaggregate: bool = False
+    # ---- per-request distributed tracing (utils/tracing.py, docs/OBSERVABILITY.md) ----
+    # every request carries a span tree (queue wait, admission, prefill chunks,
+    # decode/verify, preemption park/resume, router placement, disaggregated handoff)
+    # and emits one `trace` telemetry record at finish; tools/trace_export.py renders
+    # Perfetto timelines, tools/trace_analyze.py the critical-path TTFT attribution.
+    # Off by default and zero-cost when off (outputs, records, and compile counts are
+    # byte-identical to an untraced run)
+    trace_requests: bool = False
 
     def model_post_init(self, __context: Any) -> None:
         _check_not_None(

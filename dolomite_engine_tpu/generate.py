@@ -59,6 +59,7 @@ def generate(args: InferenceArgs, model, params, datasets_list: list, mode: Mode
         "tensor_parallel_size",
         "replicas",
         "disaggregate",
+        "trace_requests",
     ):
         generate_kwargs.pop(key, None)
 
@@ -175,6 +176,7 @@ def _generate_with_engine(
             draft_k=gp.draft_k,
             mesh=mesh,
             sharding_rules=rules,
+            trace_requests=gp.trace_requests,
         )
         kwargs.update(overrides)
         return ServingEngine(model.model, params, **kwargs)
@@ -194,7 +196,7 @@ def _generate_with_engine(
             else:
                 replica_engine = build_engine()
             replicas.append(EngineReplica(replica_id, replica_engine))
-        router = Router(replicas)
+        router = Router(replicas, trace_requests=gp.trace_requests)
     else:
         engine = build_engine()
 

@@ -202,6 +202,20 @@ class DisaggregatedEngine:
                 if dst_pages is not None:
                     self.handoff.transfer(self.prefill.pool, src_pages, worker.pool, dst_pages)
                     self.prefill.release_handoff(state, src_slot)
+                    trace = state.trace
+                    if trace is not None:
+                        # the request's trace rode the RequestState across the seam;
+                        # the handoff span (opened when the prefill parked) closes once
+                        # the pages land on the adopting worker — ONE tree, two workers
+                        span = trace.open.pop("handoff", None)
+                        if span is not None:
+                            trace.end(
+                                span,
+                                t1=self.prefill.scheduler.clock(),
+                                dst_replica=worker.replica_id,
+                                pages=len(src_pages),
+                                transfer_ms=round(self.handoff.last_latency_s * 1e3, 3),
+                            )
                     placed = True
                     break
             if placed:

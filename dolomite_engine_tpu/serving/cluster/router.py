@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ...utils.telemetry import get_telemetry
+from ...utils.tracing import RequestTrace
 from ..scheduler import QueueFullError, RequestState
 from .disagg import DisaggregatedEngine
 
@@ -153,6 +154,7 @@ class Router:
         record_interval: int = 0,
         session_ttl_s: float = 300.0,
         clock=time.monotonic,
+        trace_requests: bool = False,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -163,6 +165,11 @@ class Router:
         self.record_interval = record_interval
         self.session_ttl_s = session_ttl_s
         self.clock = clock
+        # per-request tracing (utils/tracing.py): the router opens the trace — so the
+        # placement decision itself is a span — and hands it down through submit; the
+        # engine that finishes the request emits the single trace record. Zero-cost
+        # no-op when off (the default): no objects, no records, nothing extra written.
+        self.trace_requests = trace_requests
         self.stats = RouterStats()
         self._last_record_routed = 0
         # session_id -> (replica list index, expires_at): sticky placement so every
@@ -207,6 +214,14 @@ class Router:
         """Route one request spec (the kwargs of `ServingEngine.submit`). Raises
         QueueFullError only when EVERY replica is at its admission bound."""
         session_id = spec.get("session_id")
+        trace = spec.get("trace")
+        route = None
+        if trace is None and self.trace_requests:
+            trace = RequestTrace(clock=self.clock)
+            spec["trace"] = trace
+        if trace is not None:
+            root = trace.ensure_root(t0=self.clock())
+            route = trace.begin("route", parent=root, session=session_id is not None)
         chosen, affinity = self.select(spec["prompt_ids"], session_id)
         candidates = [chosen] + sorted(
             (r for r in self.replicas if r is not chosen), key=lambda r: r.load()
@@ -216,6 +231,13 @@ class Router:
                 state = replica.submit(**spec)
             except QueueFullError:
                 continue
+            if route is not None:
+                trace.end(
+                    route,
+                    replica_id=replica.replica_id,
+                    affinity=bool(affinity and replica is chosen),
+                    spilled=replica is not chosen,
+                )
             self.stats.routed += 1
             self.stats.per_replica_routed[replica.replica_id] = (
                 self.stats.per_replica_routed.get(replica.replica_id, 0) + 1

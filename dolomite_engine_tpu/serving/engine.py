@@ -57,6 +57,7 @@ from flax import linen as nn
 from ..ops.pallas import active_kernel_backends
 from ..ops.sampling import sample_tokens_vectorized, speculative_accept
 from ..utils.telemetry import get_telemetry
+from ..utils.tracing import RequestTrace
 from .kv_cache import TRASH_PAGE, HostSwapPool, PagedKVCachePool, SlotKVCachePool
 from .prefix_cache import PrefixCache, PrefixMatch
 from .speculation import DraftModelDrafter, NgramDrafter
@@ -272,6 +273,12 @@ class ServingEngine:
             `logical_constraint` calls resolve. Required when `mesh` is given.
         replica_id: stamped on every ``serving`` telemetry record — which replica of a
             router fleet (serving/cluster/router.py) produced it. None = standalone.
+        trace_requests: per-request distributed tracing (utils/tracing.py): every
+            submitted request carries a span tree — queue wait, admission, prefill
+            chunks, decode/verify, preemption park/resume, disaggregated handoff — and
+            emits one ``trace`` telemetry record at finish. Off by default and
+            zero-cost when off: no trace objects exist, no extra records are written,
+            outputs and compile counts are byte-identical (asserted in tests).
         prefill_only: run this engine as a disaggregation PrefillWorker (paged mode
             only): requests are admitted and chunk-prefilled as usual, the first token
             streams out, but instead of decoding, finished prefills park for
@@ -313,6 +320,7 @@ class ServingEngine:
         sharding_rules: Any = None,
         replica_id: int | None = None,
         prefill_only: bool = False,
+        trace_requests: bool = False,
     ) -> None:
         if mesh is not None and sharding_rules is None:
             raise ValueError(
@@ -378,6 +386,14 @@ class ServingEngine:
         self.sharding_rules = sharding_rules
         self.replica_id = replica_id
         self.prefill_only = prefill_only
+        self.trace_requests = trace_requests
+        # which backend the chunked-prefill attention lowers through — stamped on
+        # prefill_chunk trace spans so a timeline attributes compute to the kernel tier
+        self._prefill_backend = active_kernel_backends().get("prefill_attention", "xla")
+        # admission-attempt scratch (valid only while tracing the head's admission):
+        # pop timestamp and victims evicted on the head's behalf this attempt
+        self._admit_t0: float | None = None
+        self._admit_victims = 0
         # prefill-only mode: finished prefills parked here (slot + pages still resident)
         # until a DecodeWorker adopts their KV (serving/cluster/disagg.py)
         self._ready_handoffs: list[RequestState] = []
@@ -635,6 +651,7 @@ class ServingEngine:
         rng: jax.Array | None = None,
         priority: int = 0,
         session_id: str | None = None,
+        trace: RequestTrace | None = None,
     ) -> RequestState:
         """Enqueue a request (tier-then-FCFS; ``priority`` 0 is the top tier). A
         ``session_id`` marks the request as one turn of a conversation: its prefix
@@ -680,6 +697,21 @@ class ServingEngine:
             self.stats.rejected += 1
             get_telemetry().count("serving_requests_rejected")
             raise
+        if trace is None and self.trace_requests:
+            trace = RequestTrace(request_id=request.request_id, clock=self.scheduler.clock)
+        if trace is not None:
+            state.trace = trace
+            trace.request_id = request.request_id
+            root = trace.ensure_root(
+                t0=state.submit_t,
+                tier=request.priority,
+                prompt_tokens=len(prompt_ids),
+                max_new_tokens=request.max_new_tokens,
+                replica_id=self.replica_id,
+            )
+            trace.open["queue_wait"] = trace.begin(
+                "queue_wait", parent=root, t0=state.submit_t, tier=request.priority, segment=0
+            )
         return state
 
     # ------------------------------------------------------------------ engine loop
@@ -786,6 +818,14 @@ class ServingEngine:
         mask[0, :prompt_len] = 1
 
         do_sample, temperature, top_k, top_p = request.sampling.encoded()
+        tr = state.trace
+        if tr is not None:
+            self._admit_t0 = None
+            self._admit_victims = 0
+            t_adm = self._trace_admitted(state)
+            tr.open["prefill"] = tr.begin(
+                "prefill", parent=tr.root, t0=t_adm, slot=slot, tokens=prompt_len, resume=False
+            )
         t0 = time.perf_counter()
         token, carry, prefill_caches = self._get_prefill_fn(bucket)(
             self._variables,
@@ -819,6 +859,13 @@ class ServingEngine:
         self._top_k[slot] = top_k
         self._top_p[slot] = top_p
 
+        if tr is not None:
+            pf = tr.open.pop("prefill", None)
+            if pf is not None:
+                tr.end(pf, t1=state.first_token_t)
+            if state.ttft_s is not None:
+                tr.root.attrs["ttft_s"] = round(state.ttft_s, 6)
+            self._trace_begin_decode(state, state.first_token_t)
         if self.speculating:
             self._spec_start(slot, request.prompt_ids)
         self._deliver(state, first_token)
@@ -863,6 +910,12 @@ class ServingEngine:
             if self.scheduler.expired(state):
                 self._finish(state, RequestStatus.cancelled)
                 continue
+            # tracing: the admission span covers pop -> installed, incl. the victim
+            # eviction loop below; a blocked attempt records nothing (queue stays open)
+            self._admit_t0 = (
+                self.scheduler.clock() if state.trace is not None else None
+            )
+            self._admit_victims = 0
             if self._try_admit(state):
                 continue
             # blocked: evict strictly-lower-tier victims, one at a time, until the head
@@ -873,6 +926,7 @@ class ServingEngine:
                 if victim is None:
                     break
                 self._preempt(victim)
+                self._admit_victims += 1
                 if self._try_admit(state):
                     admitted = True
                     break
@@ -913,8 +967,9 @@ class ServingEngine:
 
         needed = worst_pages - len(match.nodes)
         shortfall = needed - self.pool.available_pages
+        reclaimed = 0
         if shortfall > 0 and self.prefix is not None:
-            self.prefix.evict(shortfall, self.pool)
+            reclaimed = self.prefix.evict(shortfall, self.pool)
         if needed > self.pool.available_pages:
             # not enough pages yet: roll back (free decrefs the attached hit pages)
             if match.cow is not None:
@@ -955,6 +1010,23 @@ class ServingEngine:
         get_telemetry().count("serving_prefix_miss_tokens", len(prefill_ids) - hit)
         if resume is None:
             self._count_admission(state, session_hit=hit > 0)
+        tr = state.trace
+        if tr is not None:
+            now = self._trace_admitted(
+                state,
+                prefix_hit_tokens=hit,
+                pages_reserved=needed,
+                pages_reclaimed=reclaimed,
+                resume=resume is not None,
+            )
+            tr.open["prefill"] = tr.begin(
+                "prefill",
+                parent=tr.phase_parent or tr.root,
+                t0=now,
+                slot=slot,
+                tokens=len(prefill_ids) - hit,
+                resume=resume is not None,
+            )
         return True
 
     def _try_restore_swapped(self, state: RequestState) -> bool:
@@ -1000,6 +1072,16 @@ class ServingEngine:
             self._spec_start(slot, request.prompt_ids + state.tokens)
         self.stats.pages_swapped_in += moved
         get_telemetry().count("serving_pages_swapped_in", moved)
+        tr = state.trace
+        if tr is not None:
+            now = self._trace_admitted(
+                state, pages_swapped_in=moved, pages_reserved=worst_pages, resume=True
+            )
+            park = tr.open.pop("preempt_park", None)
+            if park is not None:
+                tr.end(park, t1=now, pages_swapped_in=moved)
+            tr.phase_parent = None
+            self._trace_begin_decode(state, now)
         return True
 
     def _count_admission(self, state: RequestState, session_hit: bool) -> None:
@@ -1017,6 +1099,46 @@ class ServingEngine:
             if live and session_hit:
                 self.stats.session_hits += 1
                 get_telemetry().count("serving_session_hits")
+
+    # ------------------------------------------------------------------ tracing
+
+    def _trace_admitted(self, state: RequestState, **attrs) -> float:
+        """Close the open queue segment and record the admission span (pop -> now,
+        incl. the victim-eviction loop). Returns the admission end timestamp so the
+        caller starts the next phase exactly where admission ended — contiguous phases
+        are what make the critical-path TTFT sum close (utils/tracing.critical_path)."""
+        tr = state.trace
+        now = self.scheduler.clock()
+        t_pop = self._admit_t0 if self._admit_t0 is not None else now
+        queue = tr.open.pop("queue_wait", None)
+        if queue is not None:
+            tr.end(queue, t1=t_pop)
+        adm = tr.begin(
+            "admission",
+            parent=tr.phase_parent or tr.root,
+            t0=t_pop,
+            tier=state.request.priority,
+            victims_evicted=self._admit_victims,
+            **attrs,
+        )
+        tr.end(adm, t1=now)
+        return now
+
+    def _trace_begin_decode(self, state: RequestState, t0: float) -> None:
+        """Open a decode-phase span for one residency segment; `_emit_decoded` /
+        `_emit_verified` aggregate per-token segments into its tokens/steps attrs
+        (mean ITL = duration / tokens)."""
+        tr = state.trace
+        tr.open["decode"] = tr.begin(
+            "decode",
+            parent=tr.root,
+            t0=t0,
+            slot=state.slot,
+            segment=state.preemptions,
+            replica_id=self.replica_id,
+            tokens=0,
+            steps=0,
+        )
 
     # --------------------------------------------------------------- preemption
 
@@ -1049,6 +1171,7 @@ class ServingEngine:
         slot still mid-prefill just restarts its prefill — no decode state exists yet."""
         slot = state.slot
         assert slot is not None and self._slot_states.get(slot) is state
+        t_evict = self.scheduler.clock() if state.trace is not None else None
         task = self._prefill_tasks.pop(slot, None)
         if slot in self._prefill_order:
             self._prefill_order.remove(slot)
@@ -1094,6 +1217,41 @@ class ServingEngine:
         self.stats.preemptions += 1
         self.stats.preempted_by_tier[tier] = self.stats.preempted_by_tier.get(tier, 0) + 1
         get_telemetry().count("serving_preemptions")
+        tr = state.trace
+        if tr is not None:
+            # close the interrupted residency, open the park span, and nest the
+            # re-enqueue's queue segment under it — the resume's admission/prefill
+            # spans re-parent under the park too (tr.phase_parent) until it ends
+            for name in ("prefill", "decode"):
+                span = tr.open.pop(name, None)
+                if span is not None:
+                    tr.end(span, t1=t_evict, preempted=True)
+            resume = state.resume
+            resident = (
+                task.pos if task is not None
+                else (resume.resident if resume is not None else 0)
+            )
+            park = tr.begin(
+                "preempt_park",
+                parent=tr.root,
+                t0=t_evict,
+                mode=self.preemption,
+                mid_prefill=task is not None,
+                resident=resident,
+            )
+            if resume is not None and resume.swapped:
+                pages_out = -(-resume.resident // self.pool.page_size)
+                park.attrs["pages_swapped_out"] = pages_out
+                park.attrs["swap_bytes"] = int(round(pages_out * self.pool.page_bytes))
+            tr.open["preempt_park"] = park
+            tr.phase_parent = park
+            tr.open["queue_wait"] = tr.begin(
+                "queue_wait",
+                parent=park,
+                t0=self.scheduler.clock(),
+                tier=tier,
+                segment=state.preemptions,
+            )
         self.scheduler.push_front(state)
 
     def _alloc_page_reclaiming(self, slot: int, index: int) -> int:
@@ -1170,11 +1328,26 @@ class ServingEngine:
 
             # map fresh pages under the chunk's real positions before the device write
             # (reclaiming first if the oversubscribed pool ran physically dry)
+            pages_mapped = 0
             for index in range(task.pos // page_size, (task.pos + take - 1) // page_size + 1):
                 if self.pool.page_table[slot, index] == TRASH_PAGE:
                     self._alloc_page_reclaiming(slot, index)
+                    pages_mapped += 1
             if self._slot_states.get(slot) is not state:
                 continue  # reclamation preempted this very task; re-pick
+            tr = state.trace
+            chunk_span = None
+            if tr is not None:
+                chunk_span = tr.begin(
+                    "prefill_chunk",
+                    parent=tr.open.get("prefill"),
+                    t0=self.scheduler.clock(),
+                    tokens=take,
+                    width=width,
+                    pages_written=pages_mapped,
+                    backend=self._prefill_backend,
+                    final=final,
+                )
 
             ids = np.full((1, width), self.pad_token_id, np.int32)
             ids[0, :take] = prefill_ids[task.pos : task.pos + take]
@@ -1208,6 +1381,8 @@ class ServingEngine:
             get_telemetry().count("serving_prefill_tokens", take)
             task.pos += take
             budget -= take
+            if chunk_span is not None:
+                tr.end(chunk_span)
 
             if not final:
                 continue
@@ -1222,6 +1397,18 @@ class ServingEngine:
                 state.resume = None
                 if self.speculating:
                     self._spec_start(slot, state.request.prompt_ids + state.tokens)
+                if tr is not None:
+                    # recompute-resume complete: the park span ends here and decode
+                    # re-opens as a fresh top-level residency segment
+                    now = self.scheduler.clock()
+                    pf = tr.open.pop("prefill", None)
+                    if pf is not None:
+                        tr.end(pf, t1=now)
+                    park = tr.open.pop("preempt_park", None)
+                    if park is not None:
+                        tr.end(park, t1=now)
+                    tr.phase_parent = None
+                    self._trace_begin_decode(state, now)
                 continue
             state.first_token_t = self.scheduler.clock()
             if state.ttft_s is not None:
@@ -1232,11 +1419,35 @@ class ServingEngine:
             self._rngs[slot] = np.array(carry)
             if self.speculating:
                 self._spec_start(slot, prefill_ids)
+            if tr is not None:
+                # prefill phase ends exactly at the measured first token, so the
+                # critical-path sum closes against the recorded ttft_s. A request that
+                # was preempted MID-prefill re-prefilled under its park span — the park
+                # (whose child this phase was) also ends here, keeping the top-level
+                # phases contiguous across the eviction
+                pf = tr.open.pop("prefill", None)
+                if pf is not None:
+                    tr.end(pf, t1=state.first_token_t)
+                park = tr.open.pop("preempt_park", None)
+                if park is not None:
+                    tr.end(park, t1=state.first_token_t)
+                tr.phase_parent = None
+                if state.ttft_s is not None:
+                    tr.root.attrs["ttft_s"] = round(state.ttft_s, 6)
+                if not self.prefill_only:
+                    self._trace_begin_decode(state, state.first_token_t)
             self._deliver(state, first_token)
             if self.prefill_only and not state.done:
                 # park for handoff: the slot (and its pages) stays resident until a
                 # DecodeWorker adopts the KV and `release_handoff` frees it
                 self._ready_handoffs.append(state)
+                if tr is not None:
+                    tr.open["handoff"] = tr.begin(
+                        "handoff",
+                        parent=tr.root,
+                        t0=state.first_token_t,
+                        src_replica=self.replica_id,
+                    )
 
     def _decode_once_paged(self) -> None:
         page_size = self.pool.page_size
@@ -1359,6 +1570,7 @@ class ServingEngine:
         tokens = np.zeros((self.pool.num_slots, k + 1), np.int32)
         tokens[:, 0] = self._tokens
         tokens[:, 1:] = drafts
+        w0 = self.scheduler.clock()
         t0 = time.perf_counter()
         caches, accepted, bonus, new_rngs = self._verify_step(
             self._variables,
@@ -1380,7 +1592,9 @@ class ServingEngine:
         self._step_count += 1
         self.stats.decode_steps += 1
         self.stats.decode_seconds += time.perf_counter() - t0
-        self._emit_verified(decoding, drafts, num_drafts, accepted, bonus)
+        self._emit_verified(
+            decoding, drafts, num_drafts, accepted, bonus, w0, self.scheduler.clock()
+        )
 
     def _verify_once_dense(self) -> None:
         decoding = list(self._slot_states.keys())
@@ -1389,6 +1603,7 @@ class ServingEngine:
         tokens = np.zeros((self.pool.num_slots, k + 1), np.int32)
         tokens[:, 0] = self._tokens
         tokens[:, 1:] = drafts
+        w0 = self.scheduler.clock()
         t0 = time.perf_counter()
         caches, accepted, bonus, new_rngs = self._verify_step(
             self._variables,
@@ -1409,7 +1624,9 @@ class ServingEngine:
         self._step_count += 1
         self.stats.decode_steps += 1
         self.stats.decode_seconds += time.perf_counter() - t0
-        self._emit_verified(decoding, drafts, num_drafts, accepted, bonus)
+        self._emit_verified(
+            decoding, drafts, num_drafts, accepted, bonus, w0, self.scheduler.clock()
+        )
 
     def _emit_verified(
         self,
@@ -1418,6 +1635,8 @@ class ServingEngine:
         num_drafts: np.ndarray,
         accepted: np.ndarray,
         bonus: np.ndarray,
+        window_t0: float | None = None,
+        window_t1: float | None = None,
     ) -> None:
         """Commit a verify step's outcome per slot: deliver the accepted drafts in
         order, then the bonus token, honoring EOS/budget mid-window (tokens after a
@@ -1446,6 +1665,21 @@ class ServingEngine:
             self.pool.lengths[slot] += 1 + min(len(emit), acc)
             self._tokens[slot] = emit[-1]
             emitted_total += len(emit)
+            tr = state.trace
+            if tr is not None:
+                span = tr.open.get("decode")
+                if span is not None:
+                    span.attrs["tokens"] += len(emit)
+                    span.attrs["steps"] += 1
+                    if proposals and window_t0 is not None:
+                        window = tr.begin(
+                            "verify_window",
+                            parent=span,
+                            t0=window_t0,
+                            proposed=proposals,
+                            accepted=acc,
+                        )
+                        tr.end(window, t1=window_t1)
             for token in emit:
                 self._deliver(state, token)
                 if state.done:
@@ -1473,6 +1707,11 @@ class ServingEngine:
             token = int(tokens[slot])
             self._tokens[slot] = token
             emitted += 1
+            if state.trace is not None:
+                span = state.trace.open.get("decode")
+                if span is not None:  # per-token segments aggregate into the ITL span
+                    span.attrs["tokens"] += 1
+                    span.attrs["steps"] += 1
             self._deliver(state, token)
         self.stats.decode_tokens += emitted
         if emitted:
@@ -1527,6 +1766,30 @@ class ServingEngine:
         if state.first_token_t is not None and state.num_generated > 1:
             itl = (state.finish_t - state.first_token_t) / (state.num_generated - 1)
             self.stats.itl_s_by_tier.setdefault(tier, []).append(itl)
+        tr = state.trace
+        if tr is not None:
+            # close whatever phase the request died in, then the root, and emit the
+            # whole tree as ONE trace record (the finishing engine owns emission — for
+            # a disaggregated request that is the decode worker, so both workers'
+            # spans land in the same record)
+            for name in ("queue_wait", "prefill", "decode", "handoff", "preempt_park"):
+                span = tr.open.pop(name, None)
+                if span is not None:
+                    tr.end(span, t1=state.finish_t)
+            tr.end(
+                tr.root,
+                t1=state.finish_t,
+                status=str(status),
+                generated_tokens=state.num_generated,
+                preemptions=state.preemptions,
+            )
+            get_telemetry().emit_record(
+                "trace",
+                step=self._step_count,
+                trace_id=tr.trace_id,
+                request_id=state.request.request_id,
+                spans=tr.span_records(),
+            )
         if state.request.on_finish is not None:
             state.request.on_finish(state)
 
@@ -1637,6 +1900,10 @@ class ServingEngine:
             self._spec_start(slot, request.prompt_ids + state.tokens)
         self.stats.admitted += 1
         get_telemetry().count("serving_requests_admitted")
+        if state.trace is not None:
+            # decode resumes on THIS worker; the handoff span (opened on the prefill
+            # side) is closed by the disaggregation driver once the page transfer lands
+            self._trace_begin_decode(state, self.scheduler.clock())
         return pages
 
     # ------------------------------------------------------------------ telemetry

@@ -316,6 +316,13 @@ class PagedKVCachePool:
         return _cache_kv_bytes_per_token(self.caches, self.page_size)
 
     @property
+    def page_bytes(self) -> float:
+        """Resident bytes of ONE page across all layers (incl. quantized scale rows) —
+        what a swap or handoff of N pages actually moves; preemption trace spans report
+        swap traffic in these units."""
+        return self.kv_bytes_per_token * self.page_size
+
+    @property
     def pages_in_use(self) -> int:
         """Physical pages currently referenced (by slots and/or the prefix index)."""
         return (self.num_pages - 1) - len(self._free_pages)

@@ -109,6 +109,12 @@ class RequestState:
     seq: int = -1  # FCFS position within the tier, assigned once at submit
     preemptions: int = 0  # times this request was evicted mid-flight and re-enqueued
     resume: Any = None  # engine-private preemption context (swap payload / rng carry)
+    # per-request distributed trace (utils/tracing.RequestTrace) when tracing is on;
+    # None is the zero-cost default — every instrumentation site is one `is not None`
+    # check. The state object carries the live trace across every seam (router ->
+    # engine, preemption re-enqueue, disaggregated prefill -> decode handoff), which is
+    # what makes a request's lifecycle ONE tree no matter how it was scheduled.
+    trace: Any = None
 
     @property
     def tier(self) -> int:

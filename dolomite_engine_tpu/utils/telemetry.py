@@ -25,6 +25,8 @@ Sink schema (one JSON object per line; see docs/OBSERVABILITY.md):
     {"kind": "model_report", ...}                            # one-shot introspection (diagnostics.py)
     {"kind": "serving", "ts", "rank", "step", "queue_depth", "slots_active", "num_slots",
      "ttft_ms", "prefill_tok_s", "decode_tok_s", "counters"}  # serving engine (serving/engine.py)
+    {"kind": "trace",  "ts", "rank", "step", "trace_id", "request_id", "spans"}  # per-request
+                                             # span tree (utils/tracing.py, --trace only)
     {"kind": "run_end","ts", "rank", "step", "status", "counters"}
 
 The full kind -> required-field table is :data:`RECORD_SCHEMA`;
@@ -154,6 +156,13 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         "handoff_latency_ms",
         "counters",
     ),
+    # per-request distributed tracing (utils/tracing.py): one record per finished
+    # request when tracing is enabled (`--trace` / trace_requests), carrying the whole
+    # span tree — [{id, parent, name, t0, t1, attrs}] on the owning scheduler's clock.
+    # Span names come from the KNOWN_SPANS vocabulary (dolo-lint `tracing` checker);
+    # tools/trace_export.py renders Perfetto timelines and tools/trace_analyze.py the
+    # critical-path TTFT attribution from these records.
+    "trace": ("trace_id", "request_id", "spans"),
 }
 
 # every literal counter name used through the registry; `count(..., event=True)` names must

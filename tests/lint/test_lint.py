@@ -21,6 +21,7 @@ from tools.lint.checkers.kernels import KernelContractChecker
 from tools.lint.checkers.sharding import ShardingChecker, parse_logical_axes, parse_mesh_axes
 from tools.lint.checkers.telemetry import TelemetryChecker
 from tools.lint.checkers.tracer import TracerChecker
+from tools.lint.checkers.tracing import TracingChecker
 from tools.lint.framework import (
     REPO_ROOT,
     Finding,
@@ -226,6 +227,48 @@ def test_telemetry_shim_keeps_script_api(tmp_path):
     bad.write_text("get_telemetry().count('nope_counter')\n")
     errors = shim.check_package(str(tmp_path))
     assert any("nope_counter" in e and "bad.py:1" in e for e in errors)
+
+
+# ---------------------------------------------------------------- tracing spans
+
+
+def test_tracing_rule_fires_on_unknown_span(tmp_path):
+    source = (
+        "from dolomite_engine_tpu.utils.tracing import RequestTrace\n"
+        "def f(state):\n"
+        "    tr = state.trace\n"
+        "    span = tr.begin('made_up_span')\n"  # line 4: not in KNOWN_SPANS
+        "    ok = tr.begin('queue_wait')\n"  # declared: clean
+        "    other = state.trace.begin('bogus_phase')\n"  # line 6: attribute receiver
+        "    tr.end(span)\n"
+    )
+    findings = _lint_snippet(
+        tmp_path, "dolomite_engine_tpu/serving/bad7.py", source, [TracingChecker()]
+    )
+    unknown = [f for f in findings if f.rule == "tracing-unknown-span"]
+    assert [(f.line, f.message.split("'")[1]) for f in unknown] == [
+        (4, "made_up_span"),
+        (6, "bogus_phase"),
+    ]
+    # reverse direction: a fixture tree that begins almost nothing reports the
+    # declared-but-unused names (the real repo covers all of them — see the
+    # whole-repo-clean test)
+    dead = {f.message.split("'")[1] for f in findings if f.rule == "tracing-dead-span"}
+    assert "decode" in dead and "queue_wait" not in dead
+
+
+def test_tracing_rule_ignores_unrelated_begin_calls(tmp_path):
+    source = (
+        "class Transaction:\n"
+        "    def begin(self, name):\n"
+        "        return name\n"
+        "def f(db):\n"
+        "    db.begin('made_up_span')\n"  # not a trace receiver: no finding
+    )
+    findings = _lint_snippet(
+        tmp_path, "dolomite_engine_tpu/serving/bad8.py", source, [TracingChecker()]
+    )
+    assert [f for f in findings if f.rule == "tracing-unknown-span"] == []
 
 
 # ---------------------------------------------------------------- kernel contract

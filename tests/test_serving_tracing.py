@@ -1,0 +1,469 @@
+"""Per-request distributed tracing tests (utils/tracing.py + the serving integration).
+
+The load-bearing invariants:
+
+- **off is free**: with tracing off (the default) serving outputs are byte-identical,
+  decode/chunk compile counts are unchanged, and the telemetry sink carries exactly the
+  records an untraced run writes — no `trace` records, no schema drift;
+- **the critical path closes**: phases are contiguous by construction, so the TTFT
+  decomposition (queue + admission + prefill + parked) sums to the measured TTFT within
+  5% for every request, however it was scheduled;
+- **preempt -> resume is one tree**: the park span brackets the eviction gap with the
+  right mode attrs (swap page/byte traffic, recompute residency), and the re-enqueue's
+  queue/admission spans re-parent UNDER the park span;
+- **disaggregation is one tree**: a request prefilled on one worker and decoded on
+  another emits ONE trace record whose spans carry both replicas;
+- **the exports are valid**: tools/trace_export.py output is schema-valid Chrome
+  trace_event JSON (Perfetto-loadable), tools/trace_analyze.py and the
+  telemetry-summary "traces:" line render from the same records.
+
+Same tiny-model conventions as tests/test_serving*.py.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import ServingEngine, serve_batch
+from dolomite_engine_tpu.serving.cluster import DisaggregatedEngine, EngineReplica, Router
+from dolomite_engine_tpu.utils.telemetry import Telemetry, install_telemetry, uninstall_telemetry
+from dolomite_engine_tpu.utils.tracing import (
+    KNOWN_SPANS,
+    RequestTrace,
+    aggregate_critical_paths,
+    critical_path,
+    trace_record_critical_path,
+)
+
+from .test_commons import get_dense_test_config
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _engine(model, config, params, **overrides):
+    kwargs = dict(
+        num_slots=2,
+        max_len=48,
+        prefill_bucket_multiple=8,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+        page_size=PAGE,
+        prefill_chunk_tokens=16,
+    )
+    kwargs.update(overrides)
+    return ServingEngine(model, params, **kwargs)
+
+
+def _specs(config, count, length=20, max_new=6, seed=0, **extra):
+    rs = np.random.RandomState(seed)
+    return [
+        dict(
+            prompt_ids=list(map(int, rs.randint(3, config.vocab_size, length))),
+            max_new_tokens=max_new,
+            **extra,
+        )
+        for _ in range(count)
+    ]
+
+
+def _read_sink(path) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _assert_closes(state, slack=0.05):
+    path = critical_path(state.trace.spans)
+    assert path is not None and path["ttft_s"] is not None
+    total = sum(path["buckets"].values())
+    assert abs(total - path["ttft_s"]) <= slack * path["ttft_s"] + 1e-4, (
+        path["buckets"],
+        path["ttft_s"],
+    )
+    return path
+
+
+# ------------------------------------------------------------------ off = zero cost
+
+
+def test_trace_off_is_byte_identical_and_compile_free(tiny, tmp_path):
+    """The acceptance gate: with tracing off, outputs, compile counts, and the
+    telemetry record stream are byte-identical to pre-tracing behavior; with it on,
+    only `trace` records are added."""
+    config, model, params = tiny
+    specs = _specs(config, 4)
+
+    def run(trace, sink):
+        telemetry = Telemetry(sink_path=str(sink), rank=0)
+        install_telemetry(telemetry)
+        try:
+            engine = _engine(model, config, params, trace_requests=trace)
+            states = serve_batch(engine, [dict(s) for s in specs])
+        finally:
+            telemetry.close()
+            uninstall_telemetry()
+        return engine, states
+
+    engine_off, states_off = run(False, tmp_path / "off.jsonl")
+    engine_on, states_on = run(True, tmp_path / "on.jsonl")
+
+    assert [s.tokens for s in states_off] == [s.tokens for s in states_on]
+    assert engine_off.decode_compiles == engine_on.decode_compiles == 1
+    assert engine_off.chunk_compiles == engine_on.chunk_compiles
+    assert all(s.trace is None for s in states_off)
+    assert all(s.trace is not None for s in states_on)
+
+    records_off = _read_sink(tmp_path / "off.jsonl")
+    records_on = _read_sink(tmp_path / "on.jsonl")
+    kinds_off = [r["kind"] for r in records_off]
+    kinds_on = [r["kind"] for r in records_on]
+    assert "trace" not in kinds_off
+    assert kinds_on.count("trace") == len(specs)
+    # everything that is not a trace record is structurally identical: same kind
+    # sequence, same field sets (timing VALUES legitimately differ between runs)
+    rest_on = [r for r in records_on if r["kind"] != "trace"]
+    assert kinds_off == [r["kind"] for r in rest_on]
+    for off, on in zip(records_off, rest_on):
+        assert set(off) == set(on), (off["kind"], set(off) ^ set(on))
+
+
+# ------------------------------------------------------------------ basic tree + closure
+
+
+def test_trace_tree_shape_and_critical_path_closes(tiny):
+    config, model, params = tiny
+    engine = _engine(model, config, params, trace_requests=True)
+    states = serve_batch(engine, _specs(config, 4, length=20, max_new=5))
+    for state in states:
+        tr = state.trace
+        root = tr.root
+        assert root.name == "request" and root.t1 is not None
+        assert root.attrs["status"] == "completed"
+        assert {s.name for s in tr.spans} <= set(KNOWN_SPANS)
+        # exactly one closed span per phase for an unpreempted request
+        (queue,) = tr.find("queue_wait")
+        (admission,) = tr.find("admission")
+        (prefill,) = tr.find("prefill")
+        (decode,) = tr.find("decode")
+        # contiguity: queue ends where admission starts, admission where prefill
+        # starts, prefill at the first token where decode starts
+        assert queue.t1 == admission.t0 and admission.t1 == prefill.t0
+        assert prefill.t1 == decode.t0
+        assert queue.t0 == root.t0 == state.submit_t
+        # prefill chunks nest under the phase and cover the prompt
+        chunks = tr.find("prefill_chunk")
+        assert chunks and all(c.parent_id == prefill.span_id for c in chunks)
+        assert sum(c.attrs["tokens"] for c in chunks) == len(state.request.prompt_ids)
+        assert all(c.attrs["backend"] in ("xla", "pallas") for c in chunks)
+        assert sum(c.attrs["pages_written"] for c in chunks) > 0
+        # ITL span: decode segments aggregate; the first token came from prefill
+        assert decode.attrs["tokens"] == state.num_generated - 1
+        assert decode.attrs["steps"] == decode.attrs["tokens"]
+        path = _assert_closes(state)
+        assert path["tier"] == 0 and path["buckets"]["parked"] == 0.0
+
+
+def test_trace_queued_request_bills_queue_wait(tiny):
+    """With 2 slots and 4 requests, the later arrivals' TTFT is dominated by queue
+    wait — the decomposition must say so (that is its whole point)."""
+    config, model, params = tiny
+    engine = _engine(model, config, params, trace_requests=True)
+    states = serve_batch(engine, _specs(config, 4, length=20, max_new=8))
+    last = max(states, key=lambda s: s.seq)
+    path = _assert_closes(last)
+    assert path["buckets"]["queue"] > 0
+    assert path["buckets"]["queue"] > path["buckets"]["admission"]
+
+
+# ------------------------------------------------------------------ preempt -> resume
+
+
+@pytest.mark.parametrize("mode", ["swap", "recompute"])
+def test_traced_preempt_resume_spans_and_reparenting(tiny, mode):
+    """A preempted-then-resumed request yields one tree: park span with the right mode
+    attrs and duration (bracketing the eviction gap exactly), the re-enqueue's queue
+    segment re-parented under the park, and a second decode residency starting where
+    the park ends."""
+    config, model, params = tiny
+    engine = _engine(
+        model,
+        config,
+        params,
+        max_len=32,
+        num_pages=3 + 1 + 1,  # one hog's worst case + 1 spare + trash
+        preemption=mode,
+        trace_requests=True,
+        prefix_caching=mode == "recompute",
+    )
+    (hog_spec,) = _specs(config, 1, length=PAGE, max_new=2 * PAGE, seed=1, priority=2)
+    (high_spec,) = _specs(config, 1, length=PAGE, max_new=4, seed=2, priority=0)
+    hog = engine.submit(**hog_spec)
+    engine.step()  # hog admits, prefills, starts decoding
+    assert hog.status.value == "running"
+    high = engine.submit(**high_spec)
+    engine.drain()
+    assert hog.status.value == "completed" and high.status.value == "completed"
+    assert hog.preemptions == 1
+
+    tr = hog.trace
+    (park,) = tr.find("preempt_park")
+    assert park.attrs["mode"] == mode and park.t1 is not None
+    if mode == "swap":
+        assert park.attrs["pages_swapped_out"] > 0
+        assert park.attrs["swap_bytes"] > 0
+    # re-parenting: the re-enqueue queue segment and the resume admission hang off the
+    # park span, not the root
+    queues = sorted(tr.find("queue_wait"), key=lambda s: s.attrs["segment"])
+    assert [q.attrs["segment"] for q in queues] == [0, 1]
+    assert queues[0].parent_id == tr.root.span_id
+    assert queues[1].parent_id == park.span_id
+    admissions = tr.find("admission")
+    assert admissions[0].parent_id == tr.root.span_id
+    assert admissions[-1].parent_id == park.span_id
+    if mode == "recompute":
+        # the recompute prefill also nests under the park
+        prefills = tr.find("prefill")
+        assert len(prefills) == 2 and prefills[-1].parent_id == park.span_id
+    # two decode residencies bracketing the park exactly (correct durations)
+    decodes = sorted(tr.find("decode"), key=lambda s: s.attrs["segment"])
+    assert [d.attrs["segment"] for d in decodes] == [0, 1]
+    assert decodes[0].t1 == park.t0
+    assert decodes[1].t0 == park.t1
+    assert park.attrs["resident"] > 0
+    # total emitted decode tokens across residencies still adds up
+    assert sum(d.attrs["tokens"] for d in decodes) == hog.num_generated - 1
+    _assert_closes(hog)
+
+    # the beneficiary's admission recorded the eviction it forced
+    (high_admission,) = high.trace.find("admission")
+    assert high_admission.attrs["victims_evicted"] >= 1
+    _assert_closes(high)
+
+
+def test_traced_speculation_verify_windows(tiny):
+    """n-gram speculation: verify windows show up as children of the decode span with
+    proposed/accepted attrs, and the aggregate matches the engine counters."""
+    config, model, params = tiny
+    engine = _engine(model, config, params, speculate_ngram=True, draft_k=4, trace_requests=True)
+    prompt = [5, 6, 7, 8] * 6  # repetitive: the n-gram drafter actually proposes
+    state = serve_batch(
+        engine, [dict(prompt_ids=prompt, max_new_tokens=12)]
+    )[0]
+    assert engine.verify_compiles == 1
+    tr = state.trace
+    (decode,) = tr.find("decode")
+    windows = tr.find("verify_window")
+    assert windows and all(w.parent_id == decode.span_id for w in windows)
+    proposed = sum(w.attrs["proposed"] for w in windows)
+    accepted = sum(w.attrs["accepted"] for w in windows)
+    assert proposed == engine.stats.draft_tokens_proposed > 0
+    assert accepted == engine.stats.draft_tokens_accepted
+    assert all(w.t1 is not None and w.t1 >= w.t0 for w in windows)
+
+
+# ------------------------------------------------------------------ disaggregation
+
+
+def test_traced_disagg_handoff_is_one_tree(tiny, tmp_path):
+    """Prefill on worker 0, decode on worker 1: ONE trace record per request whose
+    spans carry both replicas, with the handoff span bridging the seam."""
+    config, model, params = tiny
+    telemetry = Telemetry(sink_path=str(tmp_path / "sink.jsonl"), rank=0)
+    install_telemetry(telemetry)
+    try:
+        prefill = _engine(
+            model, config, params, prefill_only=True, replica_id=0, trace_requests=True
+        )
+        worker = _engine(model, config, params, replica_id=1)
+        cluster = DisaggregatedEngine(prefill, [worker])
+        states = [cluster.submit(**spec) for spec in _specs(config, 2, length=20, max_new=4)]
+        cluster.drain()
+    finally:
+        telemetry.close()
+        uninstall_telemetry()
+    assert all(s.status.value == "completed" for s in states)
+
+    records = [r for r in _read_sink(tmp_path / "sink.jsonl") if r["kind"] == "trace"]
+    assert len(records) == len(states)  # one tree per request, not one per worker
+    for state in states:
+        tr = state.trace
+        (handoff,) = tr.find("handoff")
+        assert handoff.parent_id == tr.root.span_id
+        assert handoff.attrs["src_replica"] == 0
+        assert handoff.attrs["dst_replica"] == 1
+        assert handoff.attrs["pages"] > 0
+        assert handoff.attrs["transfer_ms"] >= 0.0
+        # prefill happened on 0 (chunks exist), decode on 1
+        assert tr.find("prefill_chunk")
+        (decode,) = tr.find("decode")
+        assert decode.attrs["replica_id"] == 1
+        assert decode.t0 >= handoff.t0
+        # TTFT ends on the prefill worker, before the handoff completes
+        assert tr.root.attrs["ttft_s"] is not None
+
+
+def test_routed_preempted_resumed_single_tree(tiny):
+    """The acceptance scenario: routed + preempted + resumed = one coherent tree with
+    a route span, and the critical-path sum still matches measured TTFT within 5%."""
+    config, model, params = tiny
+    engine = _engine(
+        model,
+        config,
+        params,
+        max_len=32,
+        num_pages=3 + 1 + 1,
+        preemption="swap",
+        trace_requests=True,
+    )
+    router = Router([EngineReplica(0, engine)], trace_requests=True)
+    (hog_spec,) = _specs(config, 1, length=PAGE, max_new=2 * PAGE, seed=3, priority=2)
+    (high_spec,) = _specs(config, 1, length=PAGE, max_new=4, seed=4, priority=0)
+    hog = router.submit(**hog_spec)
+    router.step()
+    high = router.submit(**high_spec)
+    router.drain()
+    assert hog.status.value == "completed" and high.status.value == "completed"
+    assert hog.preemptions == 1
+
+    for state in (hog, high):
+        tr = state.trace
+        (route,) = tr.find("route")
+        assert route.parent_id == tr.root.span_id
+        assert route.attrs["replica_id"] == 0
+        # single tree: every non-root span's parent resolves within this trace
+        ids = {s.span_id for s in tr.spans}
+        assert all(s.parent_id in ids for s in tr.spans if s is not tr.root)
+        _assert_closes(state)
+    assert hog.trace.find("preempt_park")
+
+
+# ------------------------------------------------------------------ tools
+
+
+@pytest.fixture(scope="module")
+def traced_sink(tiny, tmp_path_factory):
+    """One traced contended run's sink, shared by the tool tests."""
+    config, model, params = tiny
+    tmp = tmp_path_factory.mktemp("traced")
+    sink = tmp / "telemetry.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        from dolomite_engine_tpu.serving import TierSLO
+
+        engine = _engine(
+            model,
+            config,
+            params,
+            max_len=32,
+            num_pages=3 + 1 + 1,
+            preemption="swap",
+            trace_requests=True,
+            tier_slos={0: TierSLO(ttft_target_s=0.5), 2: TierSLO(ttft_target_s=60.0)},
+        )
+        hog = engine.submit(**_specs(config, 1, length=PAGE, max_new=2 * PAGE, seed=5, priority=2)[0])
+        engine.step()
+        engine.submit(**_specs(config, 1, length=PAGE, max_new=4, seed=6, priority=0)[0])
+        engine.drain()
+        assert hog.preemptions == 1
+    finally:
+        telemetry.close()
+        uninstall_telemetry()
+    # torn tail line: every reader must survive it
+    with open(sink, "a") as f:
+        f.write('{"kind": "trace", "trace_id": "torn-mid-')
+    return sink
+
+
+def test_trace_export_emits_valid_perfetto_json(traced_sink, tmp_path):
+    from tools import trace_export
+
+    out = tmp_path / "perfetto.json"
+    assert trace_export.main([str(traced_sink), "-o", str(out)]) == 0
+    with open(out) as f:
+        payload = json.load(f)
+    events = payload["traceEvents"]
+    assert isinstance(events, list) and events
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert complete and meta
+    for event in events:
+        assert {"name", "ph", "pid", "tid"} <= set(event)
+        if event["ph"] == "X":
+            assert event["ts"] >= 0 and event["dur"] >= 0
+            assert "args" in event and "trace_id" in event["args"]
+    # one track per slot plus the scheduler track, all named
+    names = {e["args"]["name"] for e in meta if e["name"] == "thread_name"}
+    assert "scheduler" in names and any(n.startswith("slot ") for n in names)
+    assert {e["name"] for e in complete} <= set(KNOWN_SPANS)
+    assert any(e["name"] == "preempt_park" for e in complete)
+
+
+def test_trace_analyze_attributes_by_tier(traced_sink, capsys):
+    from tools import trace_analyze
+
+    assert trace_analyze.main([str(traced_sink), "--per-request"]) == 0
+    out = capsys.readouterr().out
+    assert "critical-path TTFT attribution" in out
+    assert "| tier |" in out and "top bucket" in out
+    # machine-readable path too, with SLO targets picked up from the serving record
+    assert trace_analyze.main([str(traced_sink), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["requests"] == 2
+    assert "0" in payload["slo_ttft_s_by_tier"]
+    tiers = payload["tiers"]
+    assert set(tiers) == {"0", "2"}
+    # the hog (tier 2) was parked; the decomposition must show it
+    assert tiers["2"]["mean_buckets_s"]["prefill"] >= 0.0
+    for entry in tiers.values():
+        assert entry["ttft_p50_s"] is not None
+        total = sum(entry["mean_buckets_s"].values())
+        assert abs(total - entry["ttft_p50_s"]) <= 0.05 * entry["ttft_p50_s"] + 1e-3
+
+
+def test_telemetry_summary_renders_traces_line(traced_sink):
+    from tools.telemetry_summary import read_records, summarize
+
+    records, bad = read_records([str(traced_sink)])
+    assert bad == 1  # the torn tail line is counted, never fatal
+    text = summarize(records)
+    assert "traces: 2 request(s)" in text
+    assert "tier 0" in text and "p50 ttft" in text and "top bucket" in text
+
+
+def test_critical_path_aggregation_and_slo_misses():
+    """Unit: aggregation flags SLO misses and names the dominant bucket."""
+    clock = iter(np.arange(0.0, 100.0, 0.5))
+    trace = RequestTrace(request_id=7, clock=lambda: next(clock))
+    root = trace.ensure_root(t0=0.0, tier=1)
+    queue = trace.begin("queue_wait", parent=root, t0=0.0, segment=0)
+    trace.end(queue, t1=3.0)
+    admission = trace.begin("admission", parent=root, t0=3.0)
+    trace.end(admission, t1=3.1)
+    prefill = trace.begin("prefill", parent=root, t0=3.1)
+    trace.end(prefill, t1=4.0)
+    root.attrs["ttft_s"] = 4.0
+    trace.end(root, t1=6.0, status="completed")
+
+    path = trace_record_critical_path(trace.to_record())
+    assert path["request_id"] == 7 and path["tier"] == 1
+    assert path["buckets"]["queue"] == pytest.approx(3.0)
+    assert path["buckets"]["prefill"] == pytest.approx(0.9)
+    assert sum(path["buckets"].values()) == pytest.approx(4.0, abs=1e-6)
+
+    aggregate = aggregate_critical_paths([path], {1: 1.0})
+    entry = aggregate[1]
+    assert entry["misses"] == 1
+    assert entry["miss_top_bucket"] == "queue"
+    assert entry["ttft_p99_s"] == pytest.approx(4.0)

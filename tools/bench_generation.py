@@ -279,6 +279,19 @@ def main() -> None:
                 }
             )
         )
+        # trace-derived attribution: where the mean high-tier TTFT went in each arm
+        # (per-request span trees, utils/tracing.critical_path — not aggregate counters)
+        print(
+            json.dumps(
+                {
+                    "metric": "high_tier_ttft_split_ms",
+                    "unit": "mean high-tier critical-path TTFT decomposition (ms): "
+                    "queue wait / prefill / parked, from per-request traces",
+                    "baseline": ab["baseline"]["high_tier_ttft_split_ms"],
+                    "preemption": ab["preemption"]["high_tier_ttft_split_ms"],
+                }
+            )
+        )
 
     if not args.seq2seq and args.replicas > 0:
         ab = record["router_ab"]
@@ -602,6 +615,28 @@ def _bench_kv_dtype_ab(model, params, config, args) -> dict:
     }
 
 
+def _mean_ttft_split_ms(states, tier: int) -> dict | None:
+    """Mean critical-path TTFT decomposition (ms) over one tier's traced requests —
+    where the winning arm's TTFT actually went (queue wait vs prefill vs parked), from
+    the per-request span trees rather than aggregate counters."""
+    from dolomite_engine_tpu.utils.tracing import critical_path
+
+    splits = []
+    for state in states:
+        if state.request.priority != tier or state.trace is None:
+            continue
+        path = critical_path(state.trace.spans)
+        if path is None or path["ttft_s"] is None:
+            continue
+        splits.append(path["buckets"])
+    if not splits:
+        return None
+    return {
+        name: round(1e3 * sum(split[name] for split in splits) / len(splits), 3)
+        for name in splits[0]
+    }
+
+
 def _bench_overload_mix(model, params, config, args) -> dict:
     """Contention-aware scheduling vs reserve-everything on a two-tier overload.
 
@@ -667,6 +702,9 @@ def _bench_overload_mix(model, params, config, args) -> dict:
             preemption=preemption,
             oversubscribe_ratio=ratio,
             tier_slos=tier_slos,
+            # per-request tracing ON in both arms (same host-side cost each side): the
+            # spans are what the trace-derived TTFT attribution line is computed from
+            trace_requests=True,
         )
 
         def one_round(measure):
@@ -708,6 +746,7 @@ def _bench_overload_mix(model, params, config, args) -> dict:
             "wall_s": round(wall, 4),
             "goodput_req_s": round(len(states) / args.reps / wall, 3),
             "high_tier_p99_ttft_ms": round(p99 * 1e3, 1),
+            "high_tier_ttft_split_ms": _mean_ttft_split_ms(states, tier=0),
             "low_tier_completed": sum(
                 1 for s in states if s.request.priority == 2 and str(s.status) == "completed"
             ),

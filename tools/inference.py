@@ -88,6 +88,14 @@ def parse_args() -> argparse.Namespace:
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
+        "--trace",
+        action="store_true",
+        help="per-request distributed tracing: emit one `trace` record per request "
+        "(span tree: queue/admission/prefill/decode) into --telemetry-sink; see "
+        "docs/OBSERVABILITY.md 'Per-request tracing'",
+    )
+    p.add_argument("--telemetry-sink", help="telemetry JSONL path (for --trace records)")
+    p.add_argument(
         "--stream",
         action="store_true",
         help="print tokens as they decode (single prompt only)",
@@ -138,6 +146,12 @@ def main() -> None:
     from dolomite_engine_tpu.model_wrapper import ModelWrapperForFinetuning
     from dolomite_engine_tpu.parallel.mesh import MeshManager
     from dolomite_engine_tpu.serving import SamplingParams, ServingEngine, serve_batch
+    from dolomite_engine_tpu.utils.telemetry import Telemetry, install_telemetry
+
+    telemetry = None
+    if args.telemetry_sink:
+        telemetry = Telemetry(sink_path=args.telemetry_sink)
+        install_telemetry(telemetry)
 
     if not MeshManager.is_initialized():
         MeshManager()
@@ -181,6 +195,7 @@ def main() -> None:
         draft_model=draft_model,
         draft_params=draft_params,
         draft_k=args.draft_k,
+        trace_requests=args.trace,
     )
 
     sampling = SamplingParams(
@@ -207,6 +222,8 @@ def main() -> None:
         for ids in prompt_ids
     ]
     states = serve_batch(engine, specs)
+    if telemetry is not None:
+        telemetry.close()
 
     if args.stream:
         print()

@@ -13,6 +13,7 @@ from .kernels import KernelContractChecker
 from .sharding import ShardingChecker
 from .telemetry import TelemetryChecker
 from .tracer import TracerChecker
+from .tracing import TracingChecker
 
 
 def all_checkers():
@@ -20,6 +21,7 @@ def all_checkers():
         ShardingChecker(),
         TracerChecker(),
         TelemetryChecker(),
+        TracingChecker(),
         KernelContractChecker(),
         ConfigDriftChecker(),
     ]

@@ -1,0 +1,99 @@
+"""Tracing span-name checker: call-site literals vs the KNOWN_SPANS vocabulary.
+
+Per-request traces (``utils/tracing.py``) are only debuggable if span names are a
+closed, documented vocabulary — `tools/trace_analyze.py` maps names to critical-path
+buckets and `tools/trace_export.py` to Perfetto tracks, so a typo'd or ad-hoc name
+silently vanishes from both. Same contract as the telemetry checker's counter/gauge
+tables, both directions:
+
+- **forward** (``tracing-unknown-span``): every literal name passed to
+  ``RequestTrace.begin`` must be a ``KNOWN_SPANS`` key. Call sites are recognized by
+  receiver: a ``.begin(...)`` on ``tr`` / anything whose expression mentions ``trace``
+  (``state.trace``, ``trace``), or ``self`` inside ``utils/tracing.py`` itself.
+- **reverse** (``tracing-dead-span``): every declared span name must have at least one
+  call site in the repo — a vocabulary entry nobody emits is schema rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..framework import Checker, Finding, SourceFile
+
+# the module allowed to call `self.begin(...)` (RequestTrace's own helpers)
+_SELF_CALL_FILES = ("tracing.py",)
+
+
+def load_known_spans() -> dict[str, str]:
+    from dolomite_engine_tpu.utils.tracing import KNOWN_SPANS
+
+    return dict(KNOWN_SPANS)
+
+
+def _is_trace_receiver(call: ast.Call, filename: str) -> bool:
+    receiver = call.func.value  # type: ignore[union-attr]
+    try:
+        text = ast.unparse(receiver)
+    except Exception:
+        return False
+    if text == "tr" or "trace" in text.lower():
+        return True
+    return text == "self" and os.path.basename(filename) in _SELF_CALL_FILES
+
+
+def scan_tree(tree: ast.AST, filename: str, known: dict) -> tuple[list[tuple[int, str]], set[str]]:
+    """One parsed file -> ([(line, message)], span names used)."""
+    errors: list[tuple[int, str]] = []
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr != "begin":
+            continue
+        if not _is_trace_receiver(node, filename):
+            continue
+        if not node.args:
+            continue
+        name = node.args[0]
+        if not (isinstance(name, ast.Constant) and isinstance(name.value, str)):
+            continue  # dynamic span name: out of scope, like dynamic gauge names
+        used.add(name.value)
+        if name.value not in known:
+            errors.append(
+                (node.lineno, f"span name '{name.value}' not in KNOWN_SPANS (utils/tracing.py)")
+            )
+    return errors, used
+
+
+class TracingChecker(Checker):
+    name = "tracing"
+    rules = ("tracing-unknown-span", "tracing-dead-span")
+
+    def __init__(self):
+        self._known: dict[str, str] = {}
+        self._used: set[str] = set()
+        self._decl_file = "dolomite_engine_tpu/utils/tracing.py"
+
+    def start(self, repo_root: str) -> None:
+        self._known = load_known_spans()
+        self._used = set()
+
+    def visit_file(self, f: SourceFile) -> list[Finding]:
+        if not (f.rel.startswith("dolomite_engine_tpu/") or f.rel.startswith("tools/")):
+            return []
+        errors, used = scan_tree(f.tree, f.path, self._known)
+        self._used |= used
+        return [Finding("tracing-unknown-span", f.rel, line, msg) for line, msg in errors]
+
+    def finalize(self) -> list[Finding]:
+        return [
+            Finding(
+                "tracing-dead-span",
+                self._decl_file,
+                1,
+                f"KNOWN_SPANS entry '{name}' has no begin() call site in the repo",
+            )
+            for name in self._known
+            if name not in self._used
+        ]

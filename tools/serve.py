@@ -144,6 +144,15 @@ def parse_args() -> argparse.Namespace:
         help="split each replica into a prefill worker and a decode worker with an "
         "explicit KV page handoff (serving/cluster/disagg.py)",
     )
+    p.add_argument(
+        "--trace",
+        action="store_true",
+        help="per-request distributed tracing (docs/OBSERVABILITY.md): every request "
+        "emits a span tree (queue/admission/prefill chunks/decode/preemption/handoff) "
+        "as `trace` records into --telemetry-sink; render with tools/trace_export.py "
+        "(Perfetto) and tools/trace_analyze.py (critical-path TTFT attribution). Off "
+        "by default; zero overhead when off",
+    )
     p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
     p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
     p.add_argument("--seed", type=int, default=0)
@@ -256,6 +265,7 @@ def main() -> None:
             draft_k=args.draft_k,
             mesh=mesh,
             sharding_rules=rules,
+            trace_requests=args.trace,
         )
         kwargs.update(overrides)
         return ServingEngine(model.model, params, **kwargs)
@@ -285,7 +295,7 @@ def main() -> None:
             else:
                 replica_engine = build_engine()
             replicas.append(EngineReplica(replica_id, replica_engine))
-        router = Router(replicas, record_interval=100)
+        router = Router(replicas, record_interval=100, trace_requests=args.trace)
     else:
         engine = build_engine()
 

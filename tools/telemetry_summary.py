@@ -22,6 +22,8 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def find_sink_files(paths: list[str]) -> list[str]:
     files: list[str] = []
@@ -151,6 +153,7 @@ def summarize(records: list[dict]) -> str:
     model_reports = [r for r in records if r.get("kind") == "model_report"]
     servings = [r for r in records if r.get("kind") == "serving"]
     routers = [r for r in records if r.get("kind") == "router"]
+    traces = [r for r in records if r.get("kind") == "trace"]
 
     lines: list[str] = []
 
@@ -355,6 +358,54 @@ def summarize(records: list[dict]) -> str:
         lines.append(", ".join(parts))
         lines.append("")
 
+    # ---------------------------------------------------------------- traces
+    if traces:
+        # per-request distributed tracing (--trace): critical-path TTFT by tier.
+        # Import lazily so summarizing an untraced sink stays dependency-free; a sink
+        # with trace records but no importable package still summarizes (count only).
+        try:
+            from dolomite_engine_tpu.utils.tracing import (
+                aggregate_critical_paths,
+                trace_record_critical_path,
+            )
+        except ImportError:
+            lines.append(f"traces: {len(traces)} request(s) (tracing module unavailable)")
+            lines.append("")
+        else:
+            targets: dict[int, float] = {}
+            for record in servings:
+                for tier, info in (record.get("tiers") or {}).items():
+                    target_ms = (info or {}).get("ttft_target_ms")
+                    if target_ms is not None:
+                        try:
+                            targets[int(tier)] = target_ms / 1e3
+                        except (TypeError, ValueError):
+                            continue
+            paths = [
+                p
+                for p in (trace_record_critical_path(r) for r in traces)
+                if p is not None
+            ]
+            aggregate = aggregate_critical_paths(paths, targets)
+            parts = [f"traces: {len(traces)} request(s)"]
+            for tier, entry in aggregate.items():
+                p50, p99 = entry["ttft_p50_s"], entry["ttft_p99_s"]
+                bits = []
+                if p50 is not None:
+                    bits.append(f"p50 ttft {p50 * 1e3:.1f}ms / p99 {p99 * 1e3:.1f}ms")
+                if entry["top_bucket"] is not None:
+                    share = entry["bucket_shares"][entry["top_bucket"]]
+                    bits.append(f"top bucket {entry['top_bucket']} {100.0 * share:.0f}%")
+                if entry.get("misses"):
+                    bits.append(
+                        f"{entry['misses']} SLO miss(es), {entry.get('miss_top_bucket')} "
+                        "dominated"
+                    )
+                tier_name = "untiered" if tier is None else f"tier {tier}"
+                parts.append(f"{tier_name}: " + ", ".join(bits) if bits else tier_name)
+            lines.append(", ".join(parts) + " (tools/trace_analyze.py for the breakdown)")
+            lines.append("")
+
     # ---------------------------------------------------------------- health / anomalies
     if healths:
         last = healths[-1]  # the latest per-group snapshot is what a triage wants first
@@ -415,7 +466,15 @@ def summarize(records: list[dict]) -> str:
         lines.append("")
 
     if not (
-        steps or windows or events or run_starts or healths or model_reports or servings or routers
+        steps
+        or windows
+        or events
+        or run_starts
+        or healths
+        or model_reports
+        or servings
+        or routers
+        or traces
     ):
         lines.append("(no telemetry records found)")
     return "\n".join(lines).rstrip() + "\n"

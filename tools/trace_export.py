@@ -1,0 +1,183 @@
+"""Export per-request trace records to Perfetto / Chrome ``trace_event`` JSON.
+
+    python tools/trace_export.py <run_dir | telemetry_dir | *.jsonl> [...] -o trace.json
+
+Reads the run's JSONL telemetry sink(s), keeps the ``trace`` records (written when
+serving ran with ``--trace`` / ``trace_requests`` — see docs/OBSERVABILITY.md
+"Per-request tracing"), and flattens every span into a complete-duration event. Open the
+output at https://ui.perfetto.dev (or chrome://tracing): one **process track per
+replica** and one **thread track per KV slot** — requests interleave on the slot tracks
+exactly as the engine scheduled them — plus a ``scheduler`` track (tid 0) for spans that
+happen outside a slot (queue wait, admission, routing) and a ``handoff`` track for the
+disaggregation transfers. Span attributes (tokens, pages, kernel backend, swap bytes,
+accept counts) land in ``args``, so clicking a chunk answers "what did this time buy".
+
+The exporter is schema-pure (no engine imports): timestamps are the scheduler-clock
+floats recorded in the spans, rebased to the earliest span and scaled to microseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def find_sink_files(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(
+                sorted(glob.glob(os.path.join(path, "**", "*.jsonl"), recursive=True))
+            )
+        else:
+            files.append(path)
+    seen: set[str] = set()
+    unique: list[str] = []
+    for f in files:
+        real = os.path.realpath(f)
+        if real not in seen:
+            seen.add(real)
+            unique.append(f)
+    return unique
+
+
+def read_trace_records(files: list[str]) -> tuple[list[dict], int]:
+    """The parseable ``trace`` records across the sinks, plus the torn-line count."""
+    records: list[dict] = []
+    bad = 0
+    for path in files:
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(record, dict) and record.get("kind") == "trace":
+                    records.append(record)
+    return records, bad
+
+
+# spans with no slot of their own render on one synthetic per-replica track each
+_SCHEDULER_TID = 0
+_HANDOFF_TID = 10_000
+
+
+def _span_track(span: dict) -> int:
+    attrs = span.get("attrs") or {}
+    if span.get("name") == "handoff":
+        return _HANDOFF_TID
+    slot = attrs.get("slot")
+    if slot is None:
+        return _SCHEDULER_TID
+    return int(slot) + 1  # tid 0 is the scheduler track
+
+
+def export_trace_events(records: list[dict]) -> dict:
+    """trace_event JSON (object form) from ``trace`` records: complete ('X') events on
+    (pid=replica, tid=slot) tracks plus 'M' metadata naming them."""
+    events: list[dict] = []
+    t_base = min(
+        (
+            span["t0"]
+            for record in records
+            for span in record.get("spans") or []
+            if span.get("t0") is not None
+        ),
+        default=0.0,
+    )
+    tracks: set[tuple[int, int]] = set()
+    for record in records:
+        spans = record.get("spans") or []
+        root_attrs = next(
+            (s.get("attrs") or {} for s in spans if s.get("name") == "request"), {}
+        )
+        default_replica = root_attrs.get("replica_id") or 0
+        for span in spans:
+            t0, t1 = span.get("t0"), span.get("t1")
+            if t0 is None:
+                continue
+            attrs = dict(span.get("attrs") or {})
+            replica = attrs.get("replica_id")
+            if replica is None:
+                replica = attrs.get("src_replica", default_replica)
+            pid = int(replica or 0)
+            tid = _span_track(span)
+            tracks.add((pid, tid))
+            events.append(
+                {
+                    "name": span.get("name", "?"),
+                    "cat": "serving",
+                    "ph": "X",
+                    "ts": round((t0 - t_base) * 1e6, 3),
+                    "dur": round(max((t1 if t1 is not None else t0) - t0, 0.0) * 1e6, 3),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {
+                        "trace_id": record.get("trace_id"),
+                        "request_id": record.get("request_id"),
+                        **attrs,
+                    },
+                }
+            )
+    for pid in sorted({p for p, _ in tracks}):
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"replica {pid}"},
+            }
+        )
+    for pid, tid in sorted(tracks):
+        if tid == _SCHEDULER_TID:
+            name = "scheduler"
+        elif tid == _HANDOFF_TID:
+            name = "handoff"
+        else:
+            name = f"slot {tid - 1}"
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid, "args": {"name": name}}
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("paths", nargs="+", help="sink .jsonl file(s) or run directories")
+    parser.add_argument("-o", "--output", default="trace.json", help="trace_event JSON out")
+    parsed = parser.parse_args(argv)
+
+    files = find_sink_files(parsed.paths)
+    if not files:
+        print(f"no .jsonl sinks found under {parsed.paths}", file=sys.stderr)
+        return 1
+    records, bad = read_trace_records(files)
+    if not records:
+        print(
+            "no trace records found — was serving run with --trace / trace_requests?",
+            file=sys.stderr,
+        )
+        return 1
+    payload = export_trace_events(records)
+    with open(parsed.output, "w") as f:
+        json.dump(payload, f)
+    spans = sum(len(r.get("spans") or []) for r in records)
+    print(
+        f"wrote {parsed.output}: {len(records)} request trace(s), {spans} span(s) "
+        f"({len(payload['traceEvents'])} events) — open at https://ui.perfetto.dev"
+    )
+    if bad:
+        print(f"({bad} malformed line(s) skipped)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
