@@ -160,6 +160,10 @@ def main() -> None:
             resid_pdrop=0.0,
             embd_pdrop=0.0,
             attn_pdrop=0.0,
+            # the CPU-fallback line must exercise the same training fast path the TPU
+            # config claims (chunked fused CE; docs/PERFORMANCE.md "Training fast path")
+            tie_word_embeddings=True,
+            fused_lm_head_loss=True,
         )
         dtype = "fp32"
         steps = 3

@@ -395,7 +395,11 @@ class DistributedArgs(BaseArgs):
     cpu_offload: bool = False
     # gradient checkpointing method
     gradient_checkpointing_method: GradientCheckpointingMethod | None = None
-    # gradient checkpointing args ({"checkpoint_every": k})
+    # gradient checkpointing args: {"checkpoint_every": k, "policy": full|save_dots|
+    # save_attention_out|offload_dots}; legacy keys block_frequency and
+    # checkpoint_policy (raw jax.checkpoint_policies names) stay accepted. Keys and
+    # policy values are validated below (and by the dolo-lint config-drift checker)
+    # so a YAML typo fails at parse time, not after a pod claim
     gradient_checkpointing_args: dict = {}
     # zero topology
     zero_topology: ZeroTopologyArgs = ZeroTopologyArgs()
@@ -443,6 +447,26 @@ class DistributedArgs(BaseArgs):
             assert self.tensor_parallel_size > 1, (
                 "tensor parallel needs to be enabled for sequence parallel"
             )
+
+        if self.gradient_checkpointing_args:
+            known_keys = {"checkpoint_every", "block_frequency", "checkpoint_policy", "policy"}
+            unknown = set(self.gradient_checkpointing_args) - known_keys
+            if unknown:
+                raise ValueError(
+                    f"unknown gradient_checkpointing_args key(s) {sorted(unknown)} "
+                    f"(expected one of {sorted(known_keys)})"
+                )
+            policy = self.gradient_checkpointing_args.get("policy")
+            if policy is not None:
+                # deferred: the named-policy vocabulary lives next to its implementation
+                from .models.gpt_dolomite import REMAT_POLICY_NAMES
+
+                if policy not in REMAT_POLICY_NAMES:
+                    raise ValueError(
+                        f"unknown gradient_checkpointing_args.policy '{policy}' "
+                        f"(expected one of {REMAT_POLICY_NAMES}; raw "
+                        "jax.checkpoint_policies names go under 'checkpoint_policy')"
+                    )
 
 
 class AimArgs(BaseArgs):
@@ -613,25 +637,33 @@ class FaultToleranceArgs(BaseArgs):
 
 class KernelArgs(BaseArgs):
     """Per-op-family lowering backend (ops/pallas/config.py KernelConfig; docs/PERFORMANCE.md
-    "Kernel tier"). ``xla`` everywhere is the default and the numerical reference; ``pallas``
-    swaps in the hand-written TPU kernel for that family. The YAML block is installed
+    "Kernel tier"). ``auto`` — the default — resolves through the platform promotion
+    table: the family's proven backend on the detected TPU generation, plain XLA (the
+    numerical reference) everywhere else, so CPU runs never need flags. Explicit
+    ``xla``/``pallas`` pins a family regardless of platform. The YAML block is installed
     process-wide by the entry points and beats the ``DOLOMITE_KERNELS`` env override; a
     build without Pallas silently degrades back to XLA (capability probe in
     `utils/packages.py`)."""
 
     # full-sequence causal attention: GQA-native splash kernel vs legacy flash/sdpa
-    splash_attention: KernelBackend = KernelBackend.xla
+    splash_attention: KernelBackend = KernelBackend.auto
     # serving decode/verify attention straight off the paged KV pool's page table
-    paged_attention: KernelBackend = KernelBackend.xla
+    paged_attention: KernelBackend = KernelBackend.auto
     # chunked-prefill flash attention through the page table (online softmax) — the
     # serving engine's prefill chunks skip the worst-case gathered view
-    prefill_attention: KernelBackend = KernelBackend.xla
+    prefill_attention: KernelBackend = KernelBackend.auto
     # per-page KV quantization encode (int8/fp8 paged pools' quantize-on-scatter)
-    paged_kv_quant: KernelBackend = KernelBackend.xla
+    paged_kv_quant: KernelBackend = KernelBackend.auto
     # fused RMSNorm(+residual add) inside the transformer block
-    rmsnorm: KernelBackend = KernelBackend.xla
+    rmsnorm: KernelBackend = KernelBackend.auto
     # grouped-GEMM MoE dispatch (sort-by-expert segment GEMMs) for the dense + EP paths
-    moe_dispatch: KernelBackend = KernelBackend.xla
+    moe_dispatch: KernelBackend = KernelBackend.auto
+    # vocab-tiled online-logsumexp chunk reduction inside the chunked fused LM-head
+    # loss (config.fused_lm_head_loss; the XLA chunked path is already the memory win)
+    fused_ce: KernelBackend = KernelBackend.auto
+    # fused QKV-split + rotary embedding at the shared attention entry (training
+    # forward and every serving program)
+    fused_rope_qkv: KernelBackend = KernelBackend.auto
 
     def install(self) -> None:
         """Make this block the process-wide kernel selection (entry points call this
@@ -646,6 +678,8 @@ class KernelArgs(BaseArgs):
                 "paged_kv_quant": self.paged_kv_quant,
                 "rmsnorm": self.rmsnorm,
                 "moe_dispatch": self.moe_dispatch,
+                "fused_ce": self.fused_ce,
+                "fused_rope_qkv": self.fused_rope_qkv,
             }
         )
 

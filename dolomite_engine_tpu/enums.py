@@ -125,13 +125,17 @@ class FIMMode(Enum):
 class KernelBackend(Enum):
     """Lowering backend for one op family (`ops/pallas/config.py` KernelConfig).
 
-    ``xla`` is always the default and the numerical reference: the op lowers through
-    plain XLA (einsums, gathers, fused sdpa). ``pallas`` swaps in the hand-written TPU
-    kernel from `ops/pallas/` for that family — opt-in per family, benchmark-gated, and
-    parity-tested in interpret mode on CPU (docs/PERFORMANCE.md "Kernel tier")."""
+    ``xla`` is the numerical reference: the op lowers through plain XLA (einsums,
+    gathers, fused sdpa). ``pallas`` swaps in the hand-written TPU kernel from
+    `ops/pallas/` for that family — benchmark-gated and parity-tested in interpret mode
+    on CPU (docs/PERFORMANCE.md "Kernel tier"). ``auto`` — the default — resolves to the
+    platform promotion table (`ops/pallas/config.py _PLATFORM_PROMOTIONS`): the family's
+    proven backend for the detected TPU generation, and always ``xla`` off-TPU, so CPU
+    runs and numerics tests see the reference lowering without flags."""
 
     xla = "xla"
     pallas = "pallas"
+    auto = "auto"
 
 
 # MoE compute-path names. Not an Enum: configs also accept None (model default) and the

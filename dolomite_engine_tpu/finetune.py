@@ -135,7 +135,19 @@ def train(
     install_telemetry(telemetry)
     monitor = build_health_monitor(args, telemetry)
     register_crash_hook(monitor.dump_flight_record)
-    emit_model_report(telemetry, state)
+    # batch shapes come from data here, so no analytic activation-bytes estimate —
+    # the report still records which remat policy is active
+    from .train_utils import resolve_checkpointing_args
+
+    ckpt_every, ckpt_policy = resolve_checkpointing_args(
+        args.distributed_args.gradient_checkpointing_method,
+        args.distributed_args.gradient_checkpointing_args,
+    )
+    emit_model_report(
+        telemetry,
+        state,
+        remat={"checkpoint_every": ckpt_every, "policy": ckpt_policy} if ckpt_every else None,
+    )
 
     offload = _resolve_cpu_offload(args)
     jit_kwargs = _offload_jit_kwargs(state) if offload else {}

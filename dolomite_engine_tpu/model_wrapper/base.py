@@ -111,9 +111,14 @@ class ModelWrapper:
             checkpoint_every = gradient_checkpointing_args.get(
                 "checkpoint_every", gradient_checkpointing_args.get("block_frequency", 1)
             )
-            # jax.checkpoint_policies name, e.g. dots_saveable (TPU extension: block-granular
-            # torch checkpointing can't express save-matmuls-recompute-elementwise)
-            checkpoint_policy = gradient_checkpointing_args.get("checkpoint_policy")
+            # `policy` is the named vocabulary (full/save_dots/save_attention_out/
+            # offload_dots — models/gpt_dolomite.REMAT_POLICY_NAMES); the legacy
+            # `checkpoint_policy` key keeps taking raw jax.checkpoint_policies names.
+            # resolve_remat_policy accepts both; arguments.py validates the keys/values
+            # at config-parse time so a YAML typo fails before any trace
+            checkpoint_policy = gradient_checkpointing_args.get(
+                "policy", gradient_checkpointing_args.get("checkpoint_policy")
+            )
         self.checkpoint_every = checkpoint_every
         self.checkpoint_policy = checkpoint_policy
 

@@ -58,6 +58,10 @@ class CommonConfig:
     # tensor is the largest single allocation in a train step at 50k vocab.
     fused_lm_head_loss: bool = False
     loss_chunk_size: int = 256
+    # PaLM-style z-loss: coef * mean(logsumexp(logits)^2) added to the LM loss, keeping
+    # the softmax normalizer near 1 (stabilizes bf16 pretraining). 0 disables. Computed
+    # identically by the plain and the chunked fused loss paths (ops/loss.py).
+    z_loss_coef: float = 0.0
     # per-head width when it differs from n_embd // n_head (HF T5's d_kv: flan-t5-small is
     # 512 wide with 6 heads of 64); None derives it from n_embd
     attention_head_dim: int | None = None

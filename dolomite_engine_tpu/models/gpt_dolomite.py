@@ -28,6 +28,7 @@ from ..ops.rope import RoPEParams
 from .config import CommonConfig
 from .enums import PositionEmbeddingType
 from .modeling_utils import (
+    ATTENTION_OUT_CHECKPOINT_NAME,
     Block,
     KVCache,
     ParameterizedEmbedding,
@@ -58,6 +59,62 @@ _REMAT_POLICIES = (
     "nothing_saveable",
 )
 
+# the NAMED remat policies (`gradient_checkpointing_args.policy`, MaxText-style): a small
+# curated vocabulary over the raw jax.checkpoint_policies surface, each mapping to a
+# concrete memory/recompute point of the checkpointed block (docs/PERFORMANCE.md
+# "Training fast path" has the when-each-wins table; `train_utils.get_model_tflops`
+# derives its recompute term from the same names so reported MFU tracks the policy)
+REMAT_POLICY_NAMES = ("full", "save_dots", "save_attention_out", "offload_dots")
+
+
+
+def resolve_named_remat_policy(policy: str):
+    """Map a `gradient_checkpointing_args.policy` name to a jax policy fn.
+
+    - ``full``: save nothing inside the block (jax's default) — the all-or-nothing remat
+      the `checkpoint_every` knob always had; maximum recompute, minimum memory.
+    - ``save_dots``: save every matmul output, recompute only elementwise ops
+      (`dots_saveable`) — near-zero recompute FLOPs at the cost of keeping the big
+      activations.
+    - ``save_attention_out``: save only the attention sublayer output
+      (`save_only_these_names` over the `Block`'s checkpoint_name tag) — the named
+      middle ground: one [B, S, H] tensor per block survives, the MLP backward starts
+      from it instead of waiting on an attention recompute.
+    - ``offload_dots``: `save_dots`' recompute point with the saved dot outputs parked
+      in pinned host memory instead of HBM (``offload_dot_with_no_batch_dims``). Needs a
+      backend with a ``pinned_host`` memory space (`utils/jax_compat`); elsewhere it
+      falls back to ``save_dots`` with a warning — same FLOPs, no host traffic.
+    """
+    if policy == "full":
+        return None
+    if policy == "save_dots":
+        return jax.checkpoint_policies.dots_saveable
+    if policy == "save_attention_out":
+        return jax.checkpoint_policies.save_only_these_names(
+            ATTENTION_OUT_CHECKPOINT_NAME
+        )
+    if policy == "offload_dots":
+        from ..utils.jax_compat import pinned_host_supported
+
+        if not pinned_host_supported():
+            import logging
+
+            from ..utils import log_rank_0
+
+            log_rank_0(
+                logging.WARNING,
+                "gradient_checkpointing_args.policy=offload_dots needs a pinned_host "
+                "memory space, which this backend does not expose — falling back to "
+                "save_dots (same recompute point, dots stay in HBM)",
+            )
+            return jax.checkpoint_policies.dots_saveable
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
+    raise ValueError(
+        f"unknown remat policy '{policy}' (expected one of {REMAT_POLICY_NAMES})"
+    )
+
 
 def scan_group_size(n_layer: int, checkpoint_every: int) -> int:
     """Blocks per scan step under `scan_layers`: `checkpoint_every` when it enables the
@@ -70,16 +127,22 @@ def scan_group_size(n_layer: int, checkpoint_every: int) -> int:
 
 
 def resolve_remat_policy(name: str | None):
-    """Map a `gradient_checkpointing_args.checkpoint_policy` name to a jax policy fn.
+    """Map a checkpoint-policy name to a jax policy fn.
 
-    Names are `jax.checkpoint_policies` attributes (e.g. ``dots_saveable`` keeps matmul
-    outputs and recomputes only elementwise ops — the middle ground between full block
-    remat and no remat that block-granular torch checkpointing can't express). None keeps
-    jax's default (save nothing)."""
+    Accepts BOTH vocabularies: the named policies (`REMAT_POLICY_NAMES` — the
+    `gradient_checkpointing_args.policy` spelling, see `resolve_named_remat_policy`)
+    and the raw `jax.checkpoint_policies` attribute names the legacy
+    ``checkpoint_policy`` key always took (e.g. ``dots_saveable``). None keeps jax's
+    default (save nothing — the ``full`` policy)."""
     if name is None:
         return None
+    if name in REMAT_POLICY_NAMES:
+        return resolve_named_remat_policy(name)
     if name not in _REMAT_POLICIES:
-        raise ValueError(f"unknown checkpoint_policy '{name}' (expected one of {_REMAT_POLICIES})")
+        raise ValueError(
+            f"unknown checkpoint_policy '{name}' (expected a named policy "
+            f"{REMAT_POLICY_NAMES} or one of {_REMAT_POLICIES})"
+        )
     return getattr(jax.checkpoint_policies, name)
 
 
@@ -470,6 +533,7 @@ class GPTDolomiteForCausalLM(nn.Module):
                 upcast=self.config.upcast_logits_for_loss,
                 logit_scale=None if self.config.m_width is None else 1.0 / self.config.m_width,
                 compute_dtype=self.dtype,
+                z_loss_coef=self.config.z_loss_coef,
             )
         else:
             logits = self.compute_logits(hidden_states)
@@ -481,6 +545,7 @@ class GPTDolomiteForCausalLM(nn.Module):
                     attention_mask=attention_mask,
                     segment_ids=segment_ids,
                     labels=labels,
+                    z_loss_coef=self.config.z_loss_coef,
                 )
 
         if want_loss:

@@ -28,6 +28,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from ..parallel.sharding import logical_constraint
 
@@ -36,13 +37,18 @@ from ..ops.activations import get_activation_function, is_glu
 from ..ops.attention import attention as attention_op
 from ..ops.normalization import check_normalization_function, layernorm, rmsnorm
 from ..ops.pallas import use_pallas
-from ..ops.rope import RoPEParams, apply_rotary_pos_emb, get_cos_sin
+from ..ops.rope import RoPEParams, get_cos_sin, split_qkv_apply_rope
 from .config import CommonConfig
 from .enums import InitMethod, PositionEmbeddingType
 
 Dtype = Any
 
 KVCache = dict[str, jax.Array]  # {"k": [B, L, Hkv, D], "v": [B, L, Hkv, D]}
+
+# checkpoint_name tag stamped on every block's attention sublayer output; the
+# save_attention_out remat policy (models/gpt_dolomite.resolve_named_remat_policy)
+# saves exactly these tensors
+ATTENTION_OUT_CHECKPOINT_NAME = "attention_out"
 
 
 def _normal_init(std: float) -> Callable:
@@ -604,17 +610,13 @@ class Attention(nn.Module):
         qkv = c_attn(hidden_states)
         qkv = logical_constraint(qkv, ("act_batch", "act_seq_inner", "act_heads"))
 
-        query, key, value = jnp.split(
-            qkv, [num_heads * head_dim, (num_heads + num_kv_heads) * head_dim], axis=-1
+        # ONE rope+QKV call site for every program that reaches attention — training
+        # forward, serving prefill chunks, decode, and the speculative verify window all
+        # split + rotate here, so the XLA reference and the fused Pallas kernel
+        # (`fused_rope_qkv` family, ops/pallas/rope_qkv.py) swap for all of them at once
+        query, key, value = split_qkv_apply_rope(
+            qkv, num_heads, num_kv_heads, head_dim, rope_cos_sin
         )
-        query = query.reshape(batch, seq, num_heads, head_dim)
-        key = key.reshape(batch, seq, num_kv_heads, head_dim)
-        value = value.reshape(batch, seq, num_kv_heads, head_dim)
-
-        if rope_cos_sin is not None:
-            cos, sin = rope_cos_sin
-            query = apply_rotary_pos_emb(query, cos, sin)
-            key = apply_rotary_pos_emb(key, cos, sin)
 
         softmax_scale = get_softmax_scale(config, head_dim)
         attn_pdrop = 0.0 if deterministic else config.attn_pdrop
@@ -891,6 +893,10 @@ class Block(nn.Module):
         )
         if m_residual is not None:
             attn_out = attn_out * m_residual
+        # named remat anchor: the save_attention_out policy
+        # (gradient_checkpointing_args.policy, models/gpt_dolomite.py) saves exactly
+        # this tensor; without an active policy the tag is a no-op
+        attn_out = checkpoint_name(attn_out, ATTENTION_OUT_CHECKPOINT_NAME)
         # ln_2 over the residual-fused form: hidden_states comes back as
         # attn_out + residual (bitwise the old two-step add), and with the rmsnorm
         # kernel family on Pallas the pair is one fused kernel (ops/pallas/rmsnorm.py)

@@ -21,6 +21,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from ..parallel.sharding import logical_constraint
 
@@ -38,7 +39,13 @@ from ..ops.moe import (
 from .config import MoEConfig
 from .enums import InitMethod
 from .gpt_dolomite import GPTDolomiteForCausalLM, GPTDolomiteModel
-from .modeling_utils import Attention, KVCache, ParameterizedLinear, get_norm
+from .modeling_utils import (
+    ATTENTION_OUT_CHECKPOINT_NAME,
+    Attention,
+    KVCache,
+    ParameterizedLinear,
+    get_norm,
+)
 
 
 class ParameterizedExperts(nn.Module):
@@ -302,6 +309,8 @@ class SparseMoEBlock(nn.Module):
         )
         if m_residual is not None:
             attn_out = attn_out * m_residual
+        # named remat anchor for the save_attention_out policy (see modeling_utils.Block)
+        attn_out = checkpoint_name(attn_out, ATTENTION_OUT_CHECKPOINT_NAME)
         # residual-fused ln_2 (see modeling_utils.Block): one fused RMSNorm(+add) kernel
         # when the rmsnorm family runs on Pallas, bitwise-identical XLA otherwise
         h, hidden_states = get_norm(config, self.dtype, "ln_2")(attn_out, residual=residual)

@@ -1,7 +1,8 @@
 """Hand-written TPU kernel tier (ROADMAP item: benchmark-gated Pallas layer).
 
-Five kernels, each behind a per-family switch in :mod:`.config` with the plain-XLA
-lowering as the default and numerical reference:
+Each kernel family sits behind a per-family switch in :mod:`.config` with the plain-XLA
+lowering as the numerical reference (``auto`` — the default — promotes proven families
+on detected TPU generations and stays XLA everywhere else):
 
 - :mod:`.paged_attention` — ragged paged-attention decode: serving decode/verify reads
   K/V through the page table, skipping unmapped pages and padded positions instead of
@@ -13,7 +14,11 @@ lowering as the default and numerical reference:
   quantize-on-scatter (byte-identical to the XLA reference encoding);
 - :mod:`.rmsnorm` — fused RMSNorm(+residual add) inside the transformer block;
 - :mod:`.moe` — grouped-GEMM MoE dispatch (sort-by-expert, block-padded segment GEMMs,
-  scatter-combine) replacing the dense all-experts einsum.
+  scatter-combine) replacing the dense all-experts einsum;
+- :mod:`.fused_ce` — vocab-tiled online-logsumexp chunk reduction for the chunked fused
+  LM-head loss (the chunk's logits tiles never leave VMEM);
+- :mod:`.rope_qkv` — fused QKV-split + rotary embedding behind the one rope+QKV call
+  site shared by training and the serving prefill/decode/verify programs.
 
 Only the config surface is imported eagerly; kernel modules import
 `jax.experimental.pallas` and load lazily behind :func:`.config.use_pallas`, so a build
@@ -30,6 +35,8 @@ from .config import (
     install_kernel_config,
     kernel_backend,
     kernel_overrides,
+    platform_default_backend,
+    resolved_kernel_backend,
     use_pallas,
 )
 
@@ -41,5 +48,7 @@ __all__ = [
     "install_kernel_config",
     "kernel_backend",
     "kernel_overrides",
+    "platform_default_backend",
+    "resolved_kernel_backend",
     "use_pallas",
 ]

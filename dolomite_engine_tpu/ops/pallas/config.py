@@ -1,7 +1,7 @@
 """Central kernel-backend selection: ONE KernelConfig instead of scattered env sniffing.
 
 Every hand-written kernel in this package sits behind a per-op-family switch with the
-plain-XLA lowering as the default and the numerical reference:
+plain-XLA lowering as the numerical reference:
 
 - ``splash_attention``: the GQA-native Pallas splash kernel for full-sequence causal
   attention (`ops/attention.py` — previously the ad-hoc ``DOLOMITE_SPLASH_ATTENTION`` env
@@ -11,7 +11,7 @@ plain-XLA lowering as the default and the numerical reference:
   gather-then-mask.
 - ``prefill_attention``: the chunked-prefill flash kernel (`prefill_attention.py`) —
   prefill chunks read the resident prefix through the page table with online softmax
-  instead of the worst-case gathered view (the last attention path off the kernel tier).
+  instead of the worst-case gathered view.
 - ``paged_kv_quant``: the page-quantization encode kernel (`kv_quant.py`) behind the
   quantized paged KV pool's quantize-on-scatter (`ops/kv_quant.quantize_pages`);
   byte-identical to the XLA reference encoding.
@@ -19,17 +19,34 @@ plain-XLA lowering as the default and the numerical reference:
   transformer block.
 - ``moe_dispatch``: the grouped-GEMM MoE dispatch (`moe.py`) replacing the dense
   all-experts einsum.
+- ``fused_ce``: the vocab-tiled online-logsumexp chunk kernel (`fused_ce.py`) inside the
+  chunked fused LM-head loss (`ops/loss.fused_linear_cross_entropy`).
+- ``fused_rope_qkv``: the fused QKV-split + rotary-embedding kernel (`rope_qkv.py`)
+  behind the one rope+QKV call site shared by training forward and the serving
+  prefill/decode/verify programs (`ops/rope.split_qkv_apply_rope`).
 
 Selection precedence: an explicitly installed config (``install_kernel_config`` — wired
-from the ``kernel_args`` block in `arguments.py` by the CLI entry points) beats the
-``DOLOMITE_KERNELS`` env var, which beats the all-XLA default. The env var is a comma
-list of ``family=backend`` pairs; a bare family name means ``pallas``::
+from the ``kernel_args`` YAML block in `arguments.py` by the CLI entry points) beats the
+``DOLOMITE_KERNELS`` env var, which beats the ``auto`` platform default. The env var is
+a comma list of ``family[=backend]`` pairs; a bare family name means ``pallas`` and the
+literal item ``auto`` resets every family to the platform default::
 
     DOLOMITE_KERNELS=paged_attention,rmsnorm=pallas python tools/serve.py ...
+    DOLOMITE_KERNELS=auto python tools/serve.py ...          # pure platform defaults
+    DOLOMITE_KERNELS=auto,moe_dispatch=xla python ...        # defaults, one demotion
 
-Call sites gate on :func:`use_pallas`, which also folds in the capability probe
-(`utils/packages.is_pallas_available`) so a build without Pallas degrades to XLA instead
-of crashing. Tests override per-family via the :func:`kernel_overrides` context manager.
+``auto`` (the default everywhere since the promotion-defaults round) resolves through
+:data:`_PLATFORM_PROMOTIONS` — per-family tables keyed on the detected platform (TPU
+generation vs CPU/GPU), so the families with proven hardware wins lower as Pallas on TPU
+without per-run flags while CPU runs (tier-1, parity tests, emulator benches) keep the
+all-XLA reference lowering. Explicit ``xla``/``pallas`` spellings — YAML or env — always
+override the table.
+
+Call sites gate on :func:`use_pallas`, which resolves ``auto`` and folds in the
+capability probe (`utils/packages.is_pallas_available`) so a build without Pallas
+degrades to XLA instead of crashing. Tests override per-family via the
+:func:`kernel_overrides` context manager; telemetry reports the RESOLVED map
+(:func:`active_kernel_backends`) so every run records what actually lowered.
 """
 
 from __future__ import annotations
@@ -48,25 +65,100 @@ KERNEL_FAMILIES = (
     "paged_kv_quant",
     "rmsnorm",
     "moe_dispatch",
+    "fused_ce",
+    "fused_rope_qkv",
 )
+
+# Families promoted to Pallas per detected platform when a family resolves to ``auto``.
+# Keys: "tpu" is the generic TPU row; "tpu:<gen>" rows override it for one generation;
+# anything else (cpu, gpu, unknown) promotes nothing — XLA stays the reference there.
+#
+# The generic TPU row promotes the families whose wins are architectural (traffic scales
+# with resident tokens instead of the worst case: paged/prefill attention; one HBM
+# round-trip instead of three: rmsnorm, fused rope+QKV; splash measured 0.408 vs 0.358
+# MFU, PROFILE.md) — `moe_dispatch` and `fused_ce` stay on XLA pending real-TPU
+# `bench_sweep.py --kernels` A/Bs (the chunked XLA CE already delivers the memory win;
+# the grouped-GEMM numbers so far are CPU-emulator only). v2/v3 keep only the
+# conservative pair: their cores have half the VMEM of v4+ (8 MB), which the paged
+# kernels' per-page DMA windows and the splash block sizes were not tuned for.
+_PLATFORM_PROMOTIONS: dict[str, frozenset[str]] = {
+    "tpu": frozenset(
+        {
+            "splash_attention",
+            "paged_attention",
+            "prefill_attention",
+            "paged_kv_quant",
+            "rmsnorm",
+            "fused_rope_qkv",
+        }
+    ),
+    "tpu:v2": frozenset({"rmsnorm", "fused_rope_qkv"}),
+    "tpu:v3": frozenset({"rmsnorm", "fused_rope_qkv"}),
+}
 
 
 @dataclass(frozen=True)
 class KernelConfig:
-    """Backend per op family; ``xla`` everywhere is the numerics-reference default."""
+    """Backend per op family; ``auto`` resolves to the platform promotion table (always
+    ``xla`` off-TPU, so the reference lowering stays the no-flags default on CPU)."""
 
-    splash_attention: KernelBackend = KernelBackend.xla
-    paged_attention: KernelBackend = KernelBackend.xla
-    prefill_attention: KernelBackend = KernelBackend.xla
-    paged_kv_quant: KernelBackend = KernelBackend.xla
-    rmsnorm: KernelBackend = KernelBackend.xla
-    moe_dispatch: KernelBackend = KernelBackend.xla
+    splash_attention: KernelBackend = KernelBackend.auto
+    paged_attention: KernelBackend = KernelBackend.auto
+    prefill_attention: KernelBackend = KernelBackend.auto
+    paged_kv_quant: KernelBackend = KernelBackend.auto
+    rmsnorm: KernelBackend = KernelBackend.auto
+    moe_dispatch: KernelBackend = KernelBackend.auto
+    fused_ce: KernelBackend = KernelBackend.auto
+    fused_rope_qkv: KernelBackend = KernelBackend.auto
 
 
 assert tuple(f.name for f in fields(KernelConfig)) == KERNEL_FAMILIES
 
 _LOCK = threading.Lock()
 _INSTALLED: KernelConfig | None = None
+_PLATFORM_KEY: str | None = None  # cached; reset via _reset_platform_cache (tests)
+
+
+def _normalize_tpu_kind(device_kind: str) -> str:
+    """"TPU v5 lite" / "TPU v5e" / "TPU v4" -> "v5e" / "v5e" / "v4"."""
+    kind = device_kind.lower().replace("tpu", "").strip()
+    kind = kind.replace(" lite", "e").replace("lite", "e").replace(" ", "")
+    return kind
+
+
+def _detect_platform_key() -> str:
+    """"tpu:<generation>" on TPU, else the jax backend name ("cpu", "gpu").
+
+    Cached per process: resolving it initializes the backend, which every consumer of
+    this module does anyway before the first trace."""
+    global _PLATFORM_KEY
+    if _PLATFORM_KEY is None:
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "tpu":
+            try:
+                backend = f"tpu:{_normalize_tpu_kind(jax.devices()[0].device_kind)}"
+            except Exception:
+                backend = "tpu:unknown"
+        _PLATFORM_KEY = backend
+    return _PLATFORM_KEY
+
+
+def _reset_platform_cache() -> None:
+    global _PLATFORM_KEY
+    _PLATFORM_KEY = None
+
+
+def platform_default_backend(family: str) -> KernelBackend:
+    """What ``auto`` resolves to for `family` on the detected platform: the generation
+    row if present, else the generic "tpu" row on any TPU, else ``xla``."""
+    key = _detect_platform_key()
+    if key.startswith("tpu"):
+        promoted = _PLATFORM_PROMOTIONS.get(key, _PLATFORM_PROMOTIONS["tpu"])
+        if family in promoted:
+            return KernelBackend.pallas
+    return KernelBackend.xla
 
 
 def _coerce_backend(value) -> KernelBackend:
@@ -85,6 +177,11 @@ def _config_from_env() -> KernelConfig:
     overrides: dict[str, KernelBackend] = {}
     spec = os.environ.get("DOLOMITE_KERNELS", "")
     for item in filter(None, (part.strip() for part in spec.split(","))):
+        if item == "auto":
+            # explicit platform-defaults spelling: reset every family to auto (useful
+            # as a prefix before per-family demotions)
+            overrides = {family: KernelBackend.auto for family in KERNEL_FAMILIES}
+            continue
         family, sep, backend = item.partition("=")
         family = family.strip()
         if family not in KERNEL_FAMILIES:
@@ -100,7 +197,9 @@ def _config_from_env() -> KernelConfig:
 
 
 def get_kernel_config() -> KernelConfig:
-    """The active config: installed > ``DOLOMITE_KERNELS`` env > all-XLA default."""
+    """The active (UNRESOLVED) config: installed > ``DOLOMITE_KERNELS`` env > all-auto
+    default. ``auto`` entries resolve per platform at the `use_pallas` /
+    `resolved_kernel_backend` layer."""
     installed = _INSTALLED
     return installed if installed is not None else _config_from_env()
 
@@ -126,12 +225,22 @@ def install_kernel_config(config: KernelConfig | dict | None) -> None:
 
 
 def kernel_backend(family: str) -> KernelBackend:
+    """The configured backend for `family`, ``auto`` included (unresolved)."""
     return getattr(get_kernel_config(), family)
 
 
+def resolved_kernel_backend(family: str) -> KernelBackend:
+    """``xla`` or ``pallas`` for `family`: the configured backend with ``auto`` resolved
+    through the platform promotion table."""
+    backend = kernel_backend(family)
+    if backend is KernelBackend.auto:
+        backend = platform_default_backend(family)
+    return backend
+
+
 def use_pallas(family: str) -> bool:
-    """True when `family` is configured for Pallas AND the Pallas build probe passes."""
-    if kernel_backend(family) is not KernelBackend.pallas:
+    """True when `family` resolves to Pallas AND the Pallas build probe passes."""
+    if resolved_kernel_backend(family) is not KernelBackend.pallas:
         return False
     from ...utils.packages import is_pallas_available
 
@@ -140,7 +249,8 @@ def use_pallas(family: str) -> bool:
 
 def active_kernel_backends() -> dict[str, str]:
     """family -> backend-name map of what would lower right now (telemetry `run_start`
-    and `serving` records; "pallas" is reported only when the probe passes)."""
+    and `serving` records; ``auto`` is resolved and "pallas" is reported only when the
+    probe passes)."""
     return {
         family: (KernelBackend.pallas if use_pallas(family) else KernelBackend.xla).value
         for family in KERNEL_FAMILIES

@@ -1,0 +1,125 @@
+"""Fused QKV-split + rotary-embedding kernel.
+
+The XLA lowering of the attention entry (`ops/rope.split_qkv_apply_rope`) splits the
+fused ``[B, S, (Hq + 2*Hkv) * D]`` projection output, then runs the rotate-half chain
+(`mul + roll + negate + mul + add`) over Q and K as separate elementwise HLOs — the
+projection output makes three HBM round-trips before attention sees it. This kernel
+reads each head's slice once, applies the rotation in VMEM, and writes the rotated
+tensor once; V head blocks pass through untouched, so the output is the same flat QKV
+layout and the caller's split/reshape is free.
+
+One program per (row block, head): the head axis doubles as the Q/K-vs-V selector
+(heads ``< Hq + Hkv`` rotate, the V tail copies), so MHA/GQA/MQA all lower to one
+program shape per head_dim. cos/sin arrive per row (`get_cos_sin` output broadcast over
+batch), already carrying any YaRN interpolation/mscale — the kernel is scaling-agnostic.
+
+Numerics mirror `ops/rope.apply_rotary_pos_emb`: the same
+``x * cos + rotate_half(x) * sin`` in the activation dtype — fp32 parity is 1-2 ulp
+(the two lowerings contract the multiply-add chain differently), asserted in tier-1.
+Rope is on the training hot path, but no custom backward is needed: the rotation is its
+own transpose up to sign, and the `jax.custom_vjp` below reuses the kernel with negated
+``sin`` for the cotangent — the backward is one more fused kernel call, not an XLA
+fallback chain.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# only imported behind the `config.use_pallas` capability gate
+from jax.experimental import pallas as pl
+
+from .rmsnorm import _interpret_default, _pick_block_rows
+
+
+def _rope_qkv_kernel(x_ref, cos_ref, sin_ref, o_ref, *, rope_heads: int):
+    h = pl.program_id(1)
+    x = x_ref[:]
+    half = x.shape[-1] // 2
+    x1 = x[:, :half]
+    x2 = x[:, half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    roped = x * cos_ref[:] + rotated * sin_ref[:]
+    # heads [0, rope_heads) are Q and K slices of the fused layout; the V tail copies
+    o_ref[:] = jnp.where(h < rope_heads, roped, x)
+
+
+def fused_rope_qkv(
+    qkv: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Rotate the Q and K head blocks of a fused QKV tensor in one kernel.
+
+    qkv: [B, S, (num_heads + 2*num_kv_heads) * head_dim]; cos/sin: broadcastable to
+    [B, S, head_dim]. Returns the same-shaped tensor with rope applied to the Q/K
+    blocks — the caller's `jnp.split` + reshape then yields roped q/k and untouched v.
+    """
+    return _fused_rope_qkv(
+        qkv, cos, sin, num_heads, num_kv_heads, head_dim, interpret
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused_rope_qkv(qkv, cos, sin, num_heads, num_kv_heads, head_dim, interpret):
+    return _rope_qkv_call(qkv, cos, sin, num_heads, num_kv_heads, head_dim, interpret)
+
+
+def _rope_qkv_call(qkv, cos, sin, num_heads, num_kv_heads, head_dim, interpret):
+    interpret = _interpret_default(interpret)
+    batch, seq, total_dim = qkv.shape
+    total_heads = num_heads + 2 * num_kv_heads
+    assert total_dim == total_heads * head_dim, (qkv.shape, total_heads, head_dim)
+
+    rows = batch * seq
+    x2d = qkv.reshape(rows, total_dim)
+    cos2d = jnp.broadcast_to(cos.astype(qkv.dtype), (batch, seq, head_dim)).reshape(
+        rows, head_dim
+    )
+    sin2d = jnp.broadcast_to(sin.astype(qkv.dtype), (batch, seq, head_dim)).reshape(
+        rows, head_dim
+    )
+
+    block_rows = _pick_block_rows(rows)
+    padded = -(-rows // block_rows) * block_rows
+    if padded != rows:
+        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
+        cos2d = jnp.pad(cos2d, ((0, padded - rows), (0, 0)))
+        sin2d = jnp.pad(sin2d, ((0, padded - rows), (0, 0)))
+
+    grid = (padded // block_rows, total_heads)
+    head_spec = pl.BlockSpec((block_rows, head_dim), lambda i, h: (i, h))
+    cs_spec = pl.BlockSpec((block_rows, head_dim), lambda i, h: (i, 0))
+
+    out = pl.pallas_call(
+        functools.partial(_rope_qkv_kernel, rope_heads=num_heads + num_kv_heads),
+        grid=grid,
+        in_specs=[head_spec, cs_spec, cs_spec],
+        out_specs=head_spec,
+        out_shape=jax.ShapeDtypeStruct((padded, total_dim), qkv.dtype),
+        interpret=interpret,
+    )(x2d, cos2d, sin2d)
+    return out[:rows].reshape(batch, seq, total_dim)
+
+
+def _fused_rope_qkv_fwd(qkv, cos, sin, num_heads, num_kv_heads, head_dim, interpret):
+    out = _rope_qkv_call(qkv, cos, sin, num_heads, num_kv_heads, head_dim, interpret)
+    return out, (cos, sin)
+
+
+def _fused_rope_qkv_bwd(num_heads, num_kv_heads, head_dim, interpret, residuals, g):
+    # R(x) = x*cos + rot(x)*sin with rot^T = -rot, so R^T(g) = g*cos + rot(g)*(-sin):
+    # the backward is the SAME kernel with sin negated (V blocks pass g through).
+    cos, sin = residuals
+    dqkv = _rope_qkv_call(g, cos, -sin, num_heads, num_kv_heads, head_dim, interpret)
+    return dqkv, None, None
+
+
+_fused_rope_qkv.defvjp(_fused_rope_qkv_fwd, _fused_rope_qkv_bwd)

@@ -81,6 +81,54 @@ def apply_rotary_pos_emb(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Ar
     return (x * cos) + (_rotate_half(x) * sin)
 
 
+def split_qkv_apply_rope(
+    qkv: jax.Array,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    rope_cos_sin: tuple[jax.Array, jax.Array] | None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Split the fused QKV projection output and apply rotary embeddings to Q and K.
+
+    THE one rope+QKV call site: training forward, serving prefill chunks, the decode
+    step, and the speculative verify window all reach rope through
+    `models/modeling_utils.Attention.__call__`, which delegates here — so the XLA
+    reference below and the fused Pallas kernel (`ops/pallas/rope_qkv.py`, gated on the
+    ``fused_rope_qkv`` family) serve every program from a single seam.
+
+    qkv: [B, S, (num_heads + 2*num_kv_heads) * head_dim] laid out flat
+    [Q | K | V] (the repo-wide fused layout, `modeling_utils` module docstring);
+    rope_cos_sin: ([..., S, head_dim], [..., S, head_dim]) from `get_cos_sin`, or None
+    for rope-free position embeddings (split only). Returns (query [B, S, Hq, D],
+    key [B, S, Hkv, D], value [B, S, Hkv, D]) with rope already applied to Q/K.
+    """
+    batch, seq = qkv.shape[:2]
+
+    if rope_cos_sin is not None:
+        from .pallas import use_pallas
+
+        if use_pallas("fused_rope_qkv"):
+            from .pallas.rope_qkv import fused_rope_qkv
+
+            qkv = fused_rope_qkv(
+                qkv, rope_cos_sin[0], rope_cos_sin[1], num_heads, num_kv_heads, head_dim
+            )
+            rope_cos_sin = None  # rotated in-kernel; plain split below
+
+    query, key, value = jnp.split(
+        qkv, [num_heads * head_dim, (num_heads + num_kv_heads) * head_dim], axis=-1
+    )
+    query = query.reshape(batch, seq, num_heads, head_dim)
+    key = key.reshape(batch, seq, num_kv_heads, head_dim)
+    value = value.reshape(batch, seq, num_kv_heads, head_dim)
+
+    if rope_cos_sin is not None:
+        cos, sin = rope_cos_sin
+        query = apply_rotary_pos_emb(query, cos, sin)
+        key = apply_rotary_pos_emb(key, cos, sin)
+    return query, key, value
+
+
 def _rotate_half(x: jax.Array) -> jax.Array:
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([-x2, x1], axis=-1)

@@ -201,7 +201,21 @@ def train(
     install_telemetry(telemetry)
     monitor = build_health_monitor(args, telemetry)
     register_crash_hook(monitor.dump_flight_record)
-    emit_model_report(telemetry, state, model_tflops_per_step=step_tflops)
+    from .train_utils import estimate_remat_activation_bytes
+
+    emit_model_report(
+        telemetry,
+        state,
+        model_tflops_per_step=step_tflops,
+        remat=estimate_remat_activation_bytes(
+            model.config,
+            batch_size=micro_batch_size,
+            sequence_length=sequence_length,
+            gradient_checkpointing_method=args.distributed_args.gradient_checkpointing_method,
+            gradient_checkpointing_args=args.distributed_args.gradient_checkpointing_args,
+            dtype_bytes=jnp.dtype(model.dtype).itemsize,
+        ),
+    )
 
     offload = _resolve_cpu_offload(args)
     jit_kwargs = _offload_jit_kwargs(state) if offload else {}

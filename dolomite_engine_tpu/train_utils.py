@@ -334,6 +334,25 @@ def get_profiler_context(
     return nullcontext()
 
 
+def resolve_checkpointing_args(
+    gradient_checkpointing_method, gradient_checkpointing_args: dict | None
+) -> tuple[int, str]:
+    """Normalize the gradient-checkpointing knobs to ``(checkpoint_every, policy)``.
+
+    One parser for `get_model_tflops`, `estimate_remat_activation_bytes`, and the
+    `model_report` remat line, mirroring model_wrapper/base.py's key precedence
+    (``checkpoint_every`` | legacy ``block_frequency``; named ``policy`` | legacy
+    ``checkpoint_policy``). Remat is active whenever EITHER a method is set or args
+    were given — the old reader keyed on the method alone and silently reported
+    full-recompute MFU for a `None`-method run that passed args."""
+    args = gradient_checkpointing_args or {}
+    every = 0
+    if gradient_checkpointing_method is not None or args:
+        every = max(int(args.get("checkpoint_every", args.get("block_frequency", 1))), 1)
+    policy = args.get("policy", args.get("checkpoint_policy")) or "full"
+    return every, policy
+
+
 def get_model_tflops(
     config,
     batch_size: int,
@@ -342,8 +361,14 @@ def get_model_tflops(
     gradient_checkpointing_args: dict | None = None,
 ) -> float:
     """Analytic model TFLOPs per step per device-group (reference `train_utils.py:197-236`):
-    attn = 4bsh(h(1+k/n) + s), mlp = 4bshf (+2bshf GLU), lm_head = 6bshv, bwd = 2x fwd,
-    +1x fwd for each checkpointed block."""
+    attn = 4bsh(h(1+k/n) + s), mlp = 4bshf (+2bshf GLU), lm_head = 6bshv, bwd = 2x fwd.
+
+    The recompute term is derived from the SELECTED remat policy, not just
+    `checkpoint_every`: ``full`` adds one forward per checkpointed block,
+    ``save_dots``/``offload_dots`` add ~0 (only elementwise ops replay),
+    ``save_attention_out`` discounts the saved out-projection dot — so reported MFU
+    tracks the actual recompute a policy buys instead of flattering partial-remat runs.
+    """
     from .ops.activations import is_glu
 
     b = batch_size
@@ -373,12 +398,96 @@ def get_model_tflops(
     forward = l * (attention_flops + mlp_flops)
     backward = 2 * forward
 
-    checkpointed_fraction = 0.0
-    if gradient_checkpointing_method is not None:
-        every = (gradient_checkpointing_args or {}).get("checkpoint_every", 1)
-        checkpointed_fraction = 1.0 / max(every, 1)
-    recompute = forward * checkpointed_fraction
+    every, policy = resolve_checkpointing_args(
+        gradient_checkpointing_method, gradient_checkpointing_args
+    )
+    recompute = 0.0
+    if every:
+        block = attention_flops + mlp_flops
+        dots_saved = {
+            "save_dots",
+            "offload_dots",
+            "dots_saveable",
+            "checkpoint_dots",
+            "dots_with_no_batch_dims_saveable",
+            "checkpoint_dots_with_no_batch_dims",
+            "everything_saveable",
+        }
+        if policy in dots_saved:
+            # every dot output saved (or host-parked): only elementwise ops replay,
+            # which this matmul-FLOPs model counts as ~0
+            block_recompute = 0.0
+        elif policy == "save_attention_out":
+            # both sublayers still replay their internal dots for their own VJPs; the
+            # saved attention out-projection (a plain h -> h dot = 4bsh*h under the
+            # forward formula's conventions) is the dot that never re-executes
+            block_recompute = block - 4 * b * s * h * h
+        else:  # "full", nothing_saveable, and conservative fallback for raw names
+            block_recompute = block
+        recompute = l * block_recompute / max(every, 1)
 
     lm_head = 6 * b * s * h * v
 
     return (forward + backward + recompute + lm_head) / 1e12
+
+
+def estimate_remat_activation_bytes(
+    config,
+    batch_size: int,
+    sequence_length: int,
+    gradient_checkpointing_method=None,
+    gradient_checkpointing_args: dict | None = None,
+    dtype_bytes: int = 4,
+) -> dict:
+    """Analytic per-replica estimate of the activation bytes each remat policy keeps
+    live between forward and backward, and the delta vs the ``full`` policy.
+
+    Counts only what the policy SAVES (block-boundary carries plus the policy's
+    selected residuals per checkpointed block); XLA scratch, attention workspace, and
+    the non-checkpointed blocks' transients are workload-dependent and excluded, so
+    treat the numbers as a floor and the DELTA — what choosing this policy costs or
+    buys relative to ``full`` — as the robust signal. Rendered by `tools/doctor.py`
+    and the ``model_report`` record next to the state-HBM estimate.
+    """
+    every, policy = resolve_checkpointing_args(
+        gradient_checkpointing_method, gradient_checkpointing_args
+    )
+    b, s, h = batch_size, sequence_length, config.n_embd
+    f = config.n_inner
+    n = config.n_head
+    kvh = config.num_key_value_heads
+    l = config.n_layer
+
+    token_bytes = b * s * dtype_bytes
+    boundary = l // max(every, 1) * token_bytes * h if every else l * token_bytes * h
+
+    per_block_extra = 0.0
+    if every:
+        if policy in ("save_dots", "offload_dots") or "saveable" in policy:
+            # every dot output: fused qkv + attention scores + context + out proj +
+            # c_fc (2f for GLU) + c_proj
+            glu = 2 if "glu" in str(config.activation_function) else 1
+            per_block_extra = token_bytes * (
+                h * (1 + 2 * kvh / n)  # qkv projection output
+                + n * s  # attention scores [b, n, s, s]
+                + 3 * h  # context + attention out proj + mlp c_proj
+                + glu * f  # c_fc output
+            )
+        elif policy == "save_attention_out":
+            per_block_extra = token_bytes * h
+    checkpointed_blocks = (l // max(every, 1)) if every else 0
+    extra = checkpointed_blocks * per_block_extra
+
+    # offload parks the saved dots in pinned host memory: device HBM sees only the
+    # boundaries, the host pays `extra`
+    device_bytes = boundary + (0.0 if policy == "offload_dots" else extra)
+    host_bytes = extra if policy == "offload_dots" else 0.0
+    full_bytes = float(boundary)  # the full policy saves boundaries only
+
+    return {
+        "checkpoint_every": every,
+        "policy": policy,
+        "activation_bytes_per_replica": float(device_bytes),
+        "host_offload_bytes_per_replica": float(host_bytes),
+        "delta_vs_full_bytes": float(device_bytes - full_bytes),
+    }

@@ -512,6 +512,7 @@ def build_model_report(
     fp8=None,
     model_tflops_per_step: float | None = None,
     cost_analysis: dict | None = None,
+    remat: dict | None = None,
 ) -> dict:
     """One-shot introspection record: where the parameters are, how they are sharded, and
     whether the steady-state training state fits the detected per-device HBM.
@@ -574,6 +575,11 @@ def build_model_report(
     }
     if cost_analysis:
         report["cost_analysis"] = cost_analysis
+    if remat:
+        # active remat policy + estimated activation-HBM delta vs `full`
+        # (train_utils.estimate_remat_activation_bytes) next to the state-HBM estimate,
+        # so an over-capacity report points at the policy knob, not just the optimizer
+        report["remat"] = remat
     return report
 
 
@@ -581,6 +587,7 @@ def emit_model_report(
     telemetry,
     state,
     model_tflops_per_step: float | None = None,
+    remat: dict | None = None,
 ) -> dict | None:
     """Build + emit the ``model_report`` record from a materialized TrainState (both train
     loops, right after state creation). Introspection must never kill training — failures
@@ -591,6 +598,7 @@ def emit_model_report(
             opt_state=state.opt_state,
             fp8=getattr(state, "fp8", None),
             model_tflops_per_step=model_tflops_per_step,
+            remat=remat,
         )
     except Exception as error:
         log_rank_0(logging.WARNING, f"model introspection failed: {error!r}")

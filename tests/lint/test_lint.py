@@ -300,6 +300,8 @@ def test_kernel_contract_clean_on_repo():
         "paged_kv_quant",
         "rmsnorm",
         "moe_dispatch",
+        "fused_ce",
+        "fused_rope_qkv",
     }
 
 
@@ -339,6 +341,64 @@ def test_config_unknown_field_flagged(tmp_path):
     got = {f.message.split("'")[1] for f in findings}
     assert got == {"model_args.bogus_knob", "typo_args"}
     assert all(f.rule == "config-unknown-field" for f in findings)
+
+
+def test_config_gradient_checkpointing_args_key_vocabulary():
+    """A typo inside the plain-dict gradient_checkpointing_args block — key OR policy
+    value — must fail lint, not a run (ISSUE 14 satellite)."""
+    import dolomite_engine_tpu.arguments as arguments_module
+
+    checker = ConfigDriftChecker()
+    findings = []
+    checker._walk_yaml(
+        arguments_module.TrainingArgs,
+        {
+            "distributed_args": {
+                "gradient_checkpointing_args": {
+                    "checkpoint_every": 2,
+                    "polcy": "save_dots",  # typo'd key
+                    "policy": "save_dotz",  # typo'd value
+                }
+            }
+        },
+        ["distributed_args:", "  gradient_checkpointing_args:", "    polcy: save_dots"],
+        "configs/fake.yml",
+        "",
+        findings,
+    )
+    assert len(findings) == 2
+    assert all(f.rule == "config-unknown-field" for f in findings)
+    messages = " | ".join(f.message for f in findings)
+    assert "polcy" in messages and "save_dotz" in messages
+
+    # the valid spellings pass clean
+    findings = []
+    checker._walk_yaml(
+        arguments_module.TrainingArgs,
+        {
+            "distributed_args": {
+                "gradient_checkpointing_args": {
+                    "checkpoint_every": 2,
+                    "policy": "save_attention_out",
+                }
+            }
+        },
+        ["distributed_args:"],
+        "configs/fake.yml",
+        "",
+        findings,
+    )
+    assert findings == []
+
+
+def test_config_policy_vocabulary_matches_models():
+    """The lint table mirrors models/gpt_dolomite.REMAT_POLICY_NAMES — drift between
+    the two would re-open the typo hole."""
+    from dolomite_engine_tpu.models.gpt_dolomite import REMAT_POLICY_NAMES
+    from tools.lint.checkers.config_drift import _DICT_FIELD_KEYS
+
+    vocab = _DICT_FIELD_KEYS[("DistributedArgs", "gradient_checkpointing_args")]
+    assert vocab["values"]["policy"] == set(REMAT_POLICY_NAMES)
 
 
 def test_config_dead_field_detection(tmp_path):

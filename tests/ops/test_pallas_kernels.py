@@ -43,6 +43,8 @@ from dolomite_engine_tpu.ops.pallas import (
     get_kernel_config,
     install_kernel_config,
     kernel_overrides,
+    platform_default_backend,
+    resolved_kernel_backend,
     use_pallas,
 )
 from dolomite_engine_tpu.serving import ServingEngine
@@ -69,10 +71,13 @@ def _clean_kernel_selection(monkeypatch):
 # ------------------------------------------------------------------- kernel config
 
 
-def test_default_config_is_all_xla():
+def test_default_config_resolves_all_xla_off_tpu():
+    # the raw default is `auto` everywhere; on the CPU tier it must RESOLVE to the
+    # all-XLA reference lowering with no flags (the promotion table only fires on TPU)
     config = get_kernel_config()
     for family in KERNEL_FAMILIES:
-        assert getattr(config, family) is KernelBackend.xla
+        assert getattr(config, family) is KernelBackend.auto
+        assert resolved_kernel_backend(family) is KernelBackend.xla
         assert not use_pallas(family)
     assert active_kernel_backends() == {f: "xla" for f in KERNEL_FAMILIES}
 
@@ -83,7 +88,8 @@ def test_env_override_parsing(monkeypatch):
     assert config.paged_attention is KernelBackend.pallas  # bare name -> pallas
     assert config.rmsnorm is KernelBackend.pallas
     assert config.moe_dispatch is KernelBackend.xla
-    assert config.splash_attention is KernelBackend.xla
+    assert config.splash_attention is KernelBackend.auto  # untouched families stay auto
+    assert resolved_kernel_backend("splash_attention") is KernelBackend.xla  # ...cpu
 
 
 def test_env_override_legacy_splash_alias(monkeypatch):
@@ -102,7 +108,7 @@ def test_env_override_unknown_family_raises(monkeypatch):
 
 def test_installed_config_beats_env(monkeypatch):
     monkeypatch.setenv("DOLOMITE_KERNELS", "rmsnorm")
-    install_kernel_config({"moe_dispatch": "pallas"})
+    install_kernel_config({"moe_dispatch": "pallas", "rmsnorm": "xla"})
     try:
         config = get_kernel_config()
         assert config.moe_dispatch is KernelBackend.pallas
@@ -138,6 +144,78 @@ def test_kernel_args_block_installs():
         assert not use_pallas("moe_dispatch")
     finally:
         install_kernel_config(None)
+
+
+# --------------------------------------------------- platform promotion defaults
+
+
+@pytest.fixture
+def _fake_tpu_platform(monkeypatch):
+    """Pretend the detected platform is a v5e pod slice (promotion tables only; no
+    kernel actually lowers for TPU in these tests)."""
+    from dolomite_engine_tpu.ops.pallas import config as kernel_config_module
+
+    monkeypatch.setattr(kernel_config_module, "_PLATFORM_KEY", "tpu:v5e")
+    yield kernel_config_module
+
+
+def test_platform_defaults_promote_on_tpu(_fake_tpu_platform):
+    # proven families lower Pallas on a v5e with NO flags; the pending-A/B families
+    # stay on the XLA reference
+    assert platform_default_backend("rmsnorm") is KernelBackend.pallas
+    assert platform_default_backend("paged_attention") is KernelBackend.pallas
+    assert platform_default_backend("fused_rope_qkv") is KernelBackend.pallas
+    assert platform_default_backend("moe_dispatch") is KernelBackend.xla
+    assert platform_default_backend("fused_ce") is KernelBackend.xla
+    assert resolved_kernel_backend("rmsnorm") is KernelBackend.pallas
+    assert use_pallas("rmsnorm")
+
+
+def test_platform_defaults_per_generation_row(monkeypatch):
+    from dolomite_engine_tpu.ops.pallas import config as kernel_config_module
+
+    # v2/v3 use the conservative row: elementwise fusions only
+    monkeypatch.setattr(kernel_config_module, "_PLATFORM_KEY", "tpu:v3")
+    assert platform_default_backend("rmsnorm") is KernelBackend.pallas
+    assert platform_default_backend("paged_attention") is KernelBackend.xla
+    # an unknown future generation falls back to the generic tpu row
+    monkeypatch.setattr(kernel_config_module, "_PLATFORM_KEY", "tpu:v9x")
+    assert platform_default_backend("paged_attention") is KernelBackend.pallas
+
+
+def test_promotion_precedence_auto_env_yaml(_fake_tpu_platform, monkeypatch):
+    from dolomite_engine_tpu.arguments import KernelArgs
+
+    # base: auto resolves through the platform table
+    assert resolved_kernel_backend("rmsnorm") is KernelBackend.pallas
+    # env beats auto: an explicit demotion wins over the table
+    monkeypatch.setenv("DOLOMITE_KERNELS", "rmsnorm=xla")
+    assert resolved_kernel_backend("rmsnorm") is KernelBackend.xla
+    # ...and the untouched families keep resolving through the table
+    assert resolved_kernel_backend("paged_attention") is KernelBackend.pallas
+    # YAML (installed KernelArgs) beats env
+    KernelArgs(rmsnorm="pallas", paged_attention="xla").install()
+    try:
+        assert resolved_kernel_backend("rmsnorm") is KernelBackend.pallas
+        assert resolved_kernel_backend("paged_attention") is KernelBackend.xla
+        # a family the YAML leaves on auto still resolves through the table
+        assert resolved_kernel_backend("prefill_attention") is KernelBackend.pallas
+    finally:
+        install_kernel_config(None)
+
+
+def test_env_auto_spelling(_fake_tpu_platform, monkeypatch):
+    # the literal item `auto` resets every family to platform defaults; later items
+    # re-override per family
+    monkeypatch.setenv("DOLOMITE_KERNELS", "auto")
+    assert resolved_kernel_backend("rmsnorm") is KernelBackend.pallas
+    assert resolved_kernel_backend("moe_dispatch") is KernelBackend.xla
+    monkeypatch.setenv("DOLOMITE_KERNELS", "auto,rmsnorm=xla,fused_ce=auto")
+    config = get_kernel_config()
+    assert config.rmsnorm is KernelBackend.xla
+    assert config.fused_ce is KernelBackend.auto
+    assert resolved_kernel_backend("rmsnorm") is KernelBackend.xla
+    assert resolved_kernel_backend("fused_ce") is KernelBackend.xla  # pending-A/B family
 
 
 # ------------------------------------------------------------------- fused rmsnorm
@@ -764,6 +842,7 @@ def test_kernel_backends_in_telemetry_records(tmp_path):
         "splash_attention": "xla", "paged_attention": "pallas",
         "prefill_attention": "xla", "paged_kv_quant": "xla",
         "rmsnorm": "pallas", "moe_dispatch": "xla",
+        "fused_ce": "xla", "fused_rope_qkv": "xla",
     }
     assert run_start["kernels"] == expected
     assert serving["kernels"] == expected
@@ -773,3 +852,239 @@ def test_kernel_backends_in_telemetry_records(tmp_path):
 
     text = summarize(records)
     assert "pallas [paged_attention, rmsnorm]" in text
+
+
+# ------------------------------------------------------------------- fused_ce
+
+
+def _ce_fixtures(seed=0, B=2, S=24, H=32, V=211):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    hidden = jax.random.normal(ks[0], (B, S, H), jnp.float32)
+    table = jax.random.normal(ks[1], (V, H), jnp.float32) * 0.05
+    labels = jax.random.randint(ks[2], (B, S), 0, V)
+    labels = labels.at[0, :5].set(-100)  # IGNORE_INDEX rows must not contribute
+    return hidden, table, labels
+
+
+@pytest.mark.parametrize("z_coef", [0.0, 1e-3])
+def test_fused_ce_chunked_matches_unchunked_to_ulp(z_coef):
+    """Acceptance: chunked-vs-unchunked loss AND grads within 1-2 float32 ulp, with
+    the per-chunk reduction on the XLA reference and on the fused_ce kernel."""
+    from dolomite_engine_tpu.ops.loss import causal_lm_loss, fused_linear_cross_entropy
+
+    hidden, table, labels = _ce_fixtures()
+    B, S, _ = hidden.shape
+
+    def unchunked(h, t):
+        logits = jnp.dot(h, t.T)
+        return causal_lm_loss(
+            logits, jnp.zeros((B, S), jnp.int32), labels=labels, z_loss_coef=z_coef
+        )
+
+    def chunked(h, t):
+        return fused_linear_cross_entropy(
+            h, t, labels, chunk_size=7, compute_dtype=jnp.float32, z_loss_coef=z_coef
+        )
+
+    ref_loss, ref_grads = jax.value_and_grad(unchunked, argnums=(0, 1))(hidden, table)
+    for backend in ("xla", "pallas"):
+        with kernel_overrides(fused_ce=backend):
+            loss, grads = jax.value_and_grad(chunked, argnums=(0, 1))(hidden, table)
+        # loss: summation-order only -> 1-2 fp32 ulp around ~5.3
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=0, atol=2e-6)
+        for g, r in zip(grads, ref_grads):
+            # same atol style as the remat-policy matrix: ~1 fp32 ulp at magnitude 1
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), rtol=0, atol=1.2e-7
+            )
+
+
+def test_fused_ce_kernel_rowwise_terms():
+    from dolomite_engine_tpu.ops.loss import cross_entropy_terms
+    from dolomite_engine_tpu.ops.pallas.fused_ce import fused_ce_chunk
+
+    hidden, table, labels = _ce_fixtures(seed=4, V=203)  # odd vocab: exercises tiles
+    logits = jnp.dot(hidden, table.T)
+    ref = cross_entropy_terms(logits, labels, want_z=True)
+    out = fused_ce_chunk(
+        hidden, table, labels, logit_scale=None, upcast=True, compute_dtype=jnp.float32
+    )
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(float(a), float(b), rtol=3e-7)
+
+
+def test_fused_ce_logit_scale_and_bf16_compute():
+    from dolomite_engine_tpu.ops.loss import cross_entropy_terms
+    from dolomite_engine_tpu.ops.pallas.fused_ce import fused_ce_chunk
+
+    hidden, table, labels = _ce_fixtures(seed=5)
+    scale = 0.125
+    logits = (jnp.dot(hidden.astype(jnp.bfloat16), table.astype(jnp.bfloat16).T) * scale)
+    ref = cross_entropy_terms(logits, labels, upcast=True, want_z=True)
+    out = fused_ce_chunk(
+        hidden, table, labels, logit_scale=scale, upcast=True,
+        compute_dtype=jnp.bfloat16,
+    )
+    np.testing.assert_allclose(float(out[0]), float(ref[0]), rtol=2e-2)
+    np.testing.assert_allclose(float(out[2]), float(ref[2]), rtol=0)
+
+
+def _fused_loss_configs(base):
+    import dataclasses
+
+    fused = dataclasses.replace(base, fused_lm_head_loss=True, loss_chunk_size=8)
+    return base, fused
+
+
+def test_fused_ce_model_packed_z_loss_parity():
+    """The model's fused-loss path (packed segment-ids + z-loss) matches the
+    full-logits path, XLA and Pallas chunk backends alike."""
+    import dataclasses
+
+    config, model, params = _make_model()
+    config_z = dataclasses.replace(config, z_loss_coef=1e-3)
+    plain, fused = _fused_loss_configs(config_z)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(3, config.vocab_size, (2, 16)), jnp.int32)
+    # packed padding-free batch: two documents per row via segment ids
+    segment_ids = jnp.asarray([[1] * 7 + [2] * 9, [1] * 12 + [2] * 4], jnp.int32)
+    position_ids = jnp.asarray(
+        [list(range(7)) + list(range(9)), list(range(12)) + list(range(4))], jnp.int32
+    )
+
+    def loss_for(cfg, backend):
+        m = GPTDolomiteForCausalLM(config=cfg)
+        with kernel_overrides(fused_ce=backend):
+            return float(
+                m.apply(
+                    {"params": params}, ids, position_ids=position_ids,
+                    segment_ids=segment_ids, compute_loss=True,
+                ).loss
+            )
+
+    ref = loss_for(plain, "xla")
+    assert ref == pytest.approx(loss_for(fused, "xla"), abs=2e-6)
+    assert ref == pytest.approx(loss_for(fused, "pallas"), abs=2e-6)
+
+
+def test_fused_ce_moe_aux_loss_combination():
+    """moe_dolomite: fused CE + the router aux loss combine identically to the
+    full-logits path (aux is added after the CE term in both)."""
+    import dataclasses
+
+    from dolomite_engine_tpu.models.config import MoEConfig
+    from dolomite_engine_tpu.models.moe_dolomite import MoEDolomiteForCausalLM
+
+    config = MoEConfig(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        num_key_value_heads=2, attention_head_type="gqa", position_embedding_type="rope",
+        add_bias=False, activation_function="swiglu", normalization_function="rmsnorm",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0, num_experts=4,
+        num_experts_per_tok=2, router_aux_loss_coef=0.02, z_loss_coef=1e-3,
+    )
+    model = MoEDolomiteForCausalLM(config=config, moe_implementation="eager")
+    ids = jnp.asarray(np.random.RandomState(1).randint(3, 96, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    out_ref = model.apply({"params": params}, ids, compute_loss=True)
+    fused_cfg = dataclasses.replace(config, fused_lm_head_loss=True, loss_chunk_size=8)
+    for backend in ("xla", "pallas"):
+        fused_model = MoEDolomiteForCausalLM(config=fused_cfg, moe_implementation="eager")
+        with kernel_overrides(fused_ce=backend):
+            out = fused_model.apply({"params": params}, ids, compute_loss=True)
+        assert float(out.aux_loss) == float(out_ref.aux_loss)  # same aux either way
+        np.testing.assert_allclose(float(out.loss), float(out_ref.loss), rtol=0, atol=2e-6)
+
+
+def test_fused_ce_peak_logits_memory_is_o_chunk():
+    """Acceptance: the chunked lowering never materializes a [B*S, V]-sized logits
+    buffer — asserted on the jitted HLO text (the unchunked lowering must contain it,
+    the chunked one at most the [B, chunk, V] tile)."""
+    from dolomite_engine_tpu.ops.loss import causal_lm_loss, fused_linear_cross_entropy
+
+    B, S, H, V = 2, 64, 16, 199
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (B, S, H), jnp.float32)
+    table = jax.random.normal(jax.random.PRNGKey(1), (V, H), jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, V)
+    chunk = 8
+
+    def unchunked(h, t):
+        return causal_lm_loss(jnp.dot(h, t.T), jnp.zeros((B, S), jnp.int32), labels=labels)
+
+    def chunked(h, t):
+        return fused_linear_cross_entropy(
+            h, t, labels, chunk_size=chunk, compute_dtype=jnp.float32
+        )
+
+    full_shape = f"{B}x{S}x{V}xf32"
+    chunk_shape = f"{B}x{chunk}x{V}xf32"
+    # forward AND backward: grad of the loss is where remat pressure lives
+    text_unchunked = jax.jit(jax.grad(unchunked, argnums=(0, 1))).lower(hidden, table).as_text()
+    text_chunked = jax.jit(jax.grad(chunked, argnums=(0, 1))).lower(hidden, table).as_text()
+    assert full_shape in text_unchunked  # the reference really does build full logits
+    assert full_shape not in text_chunked
+    assert chunk_shape in text_chunked  # ...while the chunk tile exists
+
+
+# ------------------------------------------------------------------- fused_rope_qkv
+
+
+def _rope_qkv_fixtures(hq, hkv, D=16, B=2, S=9, yarn=False, seed=0):
+    from dolomite_engine_tpu.ops.rope import RoPEParams, get_cos_sin
+
+    scaling = (
+        {"type": "yarn", "factor": 4.0, "original_max_position_embeddings": 8}
+        if yarn
+        else None
+    )
+    rope = RoPEParams.from_config(D, rope_scaling=scaling)
+    # per-row offsets: the serving decode/verify shape (every slot at its own position)
+    pos = jnp.arange(S)[None, :] + jnp.asarray([[0], [3]])[:B]
+    cos, sin = get_cos_sin(rope, pos)
+    qkv = jax.random.normal(jax.random.PRNGKey(seed), (B, S, (hq + 2 * hkv) * D), jnp.float32)
+    return qkv, cos, sin
+
+
+@pytest.mark.parametrize("head_type,hq,hkv", [("mha", 4, 4), ("gqa", 4, 2), ("mqa", 4, 1)])
+@pytest.mark.parametrize("yarn", [False, True])
+def test_fused_rope_qkv_parity(head_type, hq, hkv, yarn):
+    from dolomite_engine_tpu.ops.rope import split_qkv_apply_rope
+
+    D = 16
+    qkv, cos, sin = _rope_qkv_fixtures(hq, hkv, D=D, yarn=yarn)
+    q0, k0, v0 = split_qkv_apply_rope(qkv, hq, hkv, D, (cos, sin))
+    with kernel_overrides(fused_rope_qkv="pallas"):
+        q1, k1, v1 = split_qkv_apply_rope(qkv, hq, hkv, D, (cos, sin))
+    # V blocks pass through untouched -> bitwise; Q/K at 1-2 fp32 ulp (the two
+    # lowerings contract the multiply-add chain differently)
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+    np.testing.assert_allclose(np.asarray(q0), np.asarray(q1), rtol=0, atol=5e-7)
+    np.testing.assert_allclose(np.asarray(k0), np.asarray(k1), rtol=0, atol=5e-7)
+
+
+def test_fused_rope_qkv_backward_matches_xla():
+    from dolomite_engine_tpu.ops.rope import split_qkv_apply_rope
+
+    hq, hkv, D = 4, 2, 16
+    qkv, cos, sin = _rope_qkv_fixtures(hq, hkv, D=D, yarn=True, seed=3)
+
+    def loss(x, backend):
+        with kernel_overrides(fused_rope_qkv=backend):
+            q, k, v = split_qkv_apply_rope(x, hq, hkv, D, (cos, sin))
+        return jnp.sum(q**2) + 0.5 * jnp.sum(k**2) + jnp.sum(v**3)
+
+    g_ref = jax.grad(lambda x: loss(x, "xla"))(qkv)
+    g_ker = jax.grad(lambda x: loss(x, "pallas"))(qkv)
+    np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_ker), rtol=0, atol=1e-6)
+
+
+def test_fused_rope_qkv_through_model_and_jit():
+    """Whole-model check through the ONE shared call site: a gpt_dolomite forward
+    (training shape) and a jitted decode-shaped call both match XLA with the kernel
+    on."""
+    config, model, params = _make_model()
+    ids = jnp.asarray(np.random.RandomState(0).randint(3, 96, (2, 12)), jnp.int32)
+    ref = model.apply({"params": params}, ids).logits
+    with kernel_overrides(fused_rope_qkv="pallas"):
+        out = jax.jit(lambda p, i: model.apply({"params": p}, i).logits)(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)

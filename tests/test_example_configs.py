@@ -84,3 +84,24 @@ def test_config_extras_and_moe_implementation_flow_to_model():
     assert model.config.router_aux_loss_coef == 0.123  # extras override
     assert model.config.n_layer == 2
     assert model.model.moe_implementation == "scatter"  # scattermoe -> scatter
+
+
+def test_gradient_checkpointing_args_validated_at_parse():
+    """A typo'd gradient_checkpointing_args key or policy value fails config parse
+    (the dolo-lint config-drift checker catches it statically too)."""
+    from dolomite_engine_tpu.arguments import DistributedArgs
+
+    # valid named policy + legacy raw checkpoint_policy both parse
+    DistributedArgs(
+        gradient_checkpointing_args={"checkpoint_every": 2, "policy": "save_dots"}
+    )
+    DistributedArgs(
+        gradient_checkpointing_args={
+            "checkpoint_every": 2,
+            "checkpoint_policy": "dots_saveable",
+        }
+    )
+    with pytest.raises(ValueError, match="unknown gradient_checkpointing_args key"):
+        DistributedArgs(gradient_checkpointing_args={"polcy": "save_dots"})
+    with pytest.raises(ValueError, match="unknown gradient_checkpointing_args.policy"):
+        DistributedArgs(gradient_checkpointing_args={"policy": "save_dotz"})

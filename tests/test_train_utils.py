@@ -67,6 +67,78 @@ def test_checkpointing_adds_recompute_fraction():
     assert got == (3 * fwd + 0.5 * fwd + lm_head) / 1e12
 
 
+def test_recompute_fraction_varies_per_policy():
+    """The recompute term derives from the SELECTED policy (ISSUE 14 acceptance):
+    full = one fwd per checkpointed block, save_dots/offload_dots ~ 0, and
+    save_attention_out discounts the saved out-projection dot."""
+    config = CommonConfig(**_COMMON)
+    b, s = 4, 128
+    attn, mlp, lm_head, l = _pieces(b, s, config)
+    h = config.n_embd
+    fwd = l * (attn + mlp)
+    base = 3 * fwd + lm_head
+
+    def tflops(policy):
+        return get_model_tflops(
+            config, b, s, gradient_checkpointing_method="block",
+            gradient_checkpointing_args={"checkpoint_every": 2, "policy": policy},
+        )
+
+    assert tflops("full") == (base + 0.5 * fwd) / 1e12
+    assert tflops("save_dots") == base / 1e12
+    assert tflops("offload_dots") == base / 1e12
+    assert tflops("save_attention_out") == (
+        base + 0.5 * (fwd - l * 4 * b * s * h * h)
+    ) / 1e12
+    # legacy raw jax names keep working through the same classifier
+    assert (
+        get_model_tflops(
+            config, b, s, "block",
+            {"checkpoint_every": 2, "checkpoint_policy": "dots_saveable"},
+        )
+        == base / 1e12
+    )
+
+
+def test_none_method_with_args_counts_recompute():
+    """Standing bug (ISSUE 14 satellite): gradient_checkpointing_args WITHOUT a method
+    used to report zero recompute — remat is active whenever args were given."""
+    config = CommonConfig(**_COMMON)
+    b, s = 4, 128
+    attn, mlp, lm_head, l = _pieces(b, s, config)
+    fwd = l * (attn + mlp)
+    got = get_model_tflops(config, b, s, None, {"checkpoint_every": 2})
+    assert got == (3 * fwd + 0.5 * fwd + lm_head) / 1e12
+    # the legacy block_frequency spelling resolves too (old reader defaulted it to 1)
+    got = get_model_tflops(config, b, s, None, {"block_frequency": 2})
+    assert got == (3 * fwd + 0.5 * fwd + lm_head) / 1e12
+    assert get_model_tflops(config, b, s, None, None) == (3 * fwd + lm_head) / 1e12
+
+
+def test_estimate_remat_activation_bytes_orders_policies():
+    """The activation estimate must order the policies the way the policies order
+    memory: save_dots > save_attention_out > full on device; offload_dots parks the
+    dots host-side and matches full on device."""
+    from dolomite_engine_tpu.train_utils import estimate_remat_activation_bytes
+
+    config = CommonConfig(**_COMMON)
+
+    def est(policy):
+        return estimate_remat_activation_bytes(
+            config, 4, 128, "block", {"checkpoint_every": 1, "policy": policy}
+        )
+
+    full, dots, attn_out, offload = map(
+        est, ("full", "save_dots", "save_attention_out", "offload_dots")
+    )
+    assert full["delta_vs_full_bytes"] == 0.0
+    assert dots["activation_bytes_per_replica"] > attn_out["activation_bytes_per_replica"]
+    assert attn_out["activation_bytes_per_replica"] > full["activation_bytes_per_replica"]
+    assert offload["activation_bytes_per_replica"] == full["activation_bytes_per_replica"]
+    assert offload["host_offload_bytes_per_replica"] > 0
+    assert attn_out["policy"] == "save_attention_out"
+
+
 def test_val_group_names_from_weighted_split_paths():
     """Named validation groups (reference pretrain.py:96-98): report names come from the
     val_weighted_split_paths group keys; absent structure -> None (numeric fallback)."""

@@ -37,7 +37,11 @@ KERNEL_AB_FAMILIES = (
     "paged_kv_quant",
     "rmsnorm",
     "moe_dispatch",
+    "fused_ce",
+    "fused_rope_qkv",
 )
+
+REMAT_AB_POLICIES = ("full", "save_dots", "save_attention_out", "offload_dots")
 
 
 def _time_jitted(fn, args, reps: int) -> float:
@@ -183,6 +187,44 @@ def _bench_kernel_family(family: str, args) -> dict:
             "q_heads": hq, "kv_heads": hkv, "head_dim": hd,
         }
         operands = (q, k_pages, v_pages)
+    elif family == "fused_ce":
+        # chunk-shaped: one fused-loss chunk's rows against a real vocab — the XLA side
+        # materializes the [rows, V] logits in HBM, the kernel tiles V through VMEM
+        rows, hidden, vocab = args.micro_bs * 64, args.n_embd, args.vocab
+        h = jax.random.normal(key, (rows, hidden), jnp.float32)
+        table = jax.random.normal(jax.random.PRNGKey(1), (vocab, hidden), jnp.float32) * 0.02
+        y = jnp.asarray(np.random.RandomState(0).randint(0, vocab, rows), jnp.int32)
+        from dolomite_engine_tpu.ops.loss import cross_entropy_terms
+        from dolomite_engine_tpu.ops.pallas.fused_ce import fused_ce_chunk
+
+        def run_xla(h):
+            logits = jnp.dot(h, table.T)
+            return cross_entropy_terms(logits, y, want_z=True)
+
+        xla_fn = jax.jit(run_xla)
+        pallas_fn = jax.jit(
+            lambda h: fused_ce_chunk(
+                h[None], table, y[None], logit_scale=None, upcast=True,
+                compute_dtype=jnp.float32,
+            )
+        )
+        shape = {"rows": rows, "hidden": hidden, "vocab": vocab}
+        operands = (h,)
+    elif family == "fused_rope_qkv":
+        # attention-entry-shaped: a full fused QKV projection output + per-row cos/sin
+        rows, hq, hkv, hd = args.micro_bs * 512, 8, 2, 64
+        total = (hq + 2 * hkv) * hd
+        qkv = jax.random.normal(key, (1, rows, total), jnp.bfloat16)
+        from dolomite_engine_tpu.ops.rope import RoPEParams, get_cos_sin, split_qkv_apply_rope
+        from dolomite_engine_tpu.ops.pallas.rope_qkv import fused_rope_qkv
+
+        rope = RoPEParams.from_config(hd)
+        cos, sin = get_cos_sin(rope, jnp.arange(rows)[None, :], dtype=jnp.bfloat16)
+
+        xla_fn = jax.jit(lambda x: split_qkv_apply_rope(x, hq, hkv, hd, (cos, sin)))
+        pallas_fn = jax.jit(lambda x: fused_rope_qkv(x, cos, sin, hq, hkv, hd))
+        shape = {"rows": rows, "q_heads": hq, "kv_heads": hkv, "head_dim": hd}
+        operands = (qkv,)
     elif family == "paged_kv_quant":
         # scatter-shaped: the batch of touched pages one engine step re-encodes
         pages_n, page, hkv, hd = args.micro_bs * 8, 16, 2, 64
@@ -202,7 +244,10 @@ def _bench_kernel_family(family: str, args) -> dict:
 
     from dolomite_engine_tpu.utils import pallas_interpret_mode
 
-    xla_ms = _time_jitted(xla_fn, operands, args.steps)
+    # pin the reference arm to XLA: with `auto` promotion defaults the dispatching call
+    # sites (e.g. split_qkv_apply_rope) would otherwise lower Pallas on TPU in both arms
+    with kernel_overrides(**{family: "xla"}):
+        xla_ms = _time_jitted(xla_fn, operands, args.steps)
     with kernel_overrides(**{family: "pallas"}):
         pallas_ms = _time_jitted(pallas_fn, operands, args.steps)
     return {
@@ -215,6 +260,147 @@ def _bench_kernel_family(family: str, args) -> dict:
         "pallas_ms": round(pallas_ms, 3),
         "pallas_speedup": round(xla_ms / pallas_ms, 3) if pallas_ms else None,
     }
+
+
+def run_remat_ab(args) -> None:
+    """Per-remat-policy train-step A/B: one ``{"bench": "train_fast_path", ...}`` JSON
+    line per policy with the step-time ratio and HBM high-water vs the ``full`` policy.
+
+    HBM high water comes from the compiled step's static buffer assignment
+    (``memory_analysis().temp_size_in_bytes``) so the line is meaningful on CPU too —
+    live ``device.memory_stats()`` peaks ride along when the backend exposes them
+    (TPU). Off-TPU the step-time column measures the CPU backend, not the claim; the
+    ``backend`` field says which you got (the PR 11 bench resilience contract: a
+    flagged line always lands, never a bench_error zero)."""
+    from dolomite_engine_tpu.enums import AttentionImplementation, LRDecaySchedule, Mode
+    from dolomite_engine_tpu.model_wrapper.pretraining import ModelWrapperForPretraining
+    from dolomite_engine_tpu.optimization import get_optimizer, get_scheduler
+    from dolomite_engine_tpu.parallel.mesh import MeshManager, named_sharding
+    from dolomite_engine_tpu.train_utils import (
+        get_model_tflops,
+        make_train_step,
+        run_timed_windows,
+    )
+    from dolomite_engine_tpu.distributed import create_sharded_train_state
+    from dolomite_engine_tpu.utils.jax_compat import pinned_host_supported
+
+    backend = jax.default_backend()
+    n_head = args.n_head or args.n_embd // 64
+    config = dict(
+        model_type="gpt_dolomite",
+        vocab_size=args.vocab,
+        n_positions=args.seq,
+        n_embd=args.n_embd,
+        n_layer=args.n_layer,
+        n_head=n_head,
+        num_key_value_heads=args.kv_heads,
+        attention_head_type="gqa",
+        position_embedding_type="rope",
+        activation_function="swiglu",
+        normalization_function="rmsnorm",
+        add_bias=False,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        tie_word_embeddings=True,
+        fused_lm_head_loss=args.fused_loss,
+        loss_chunk_size=args.loss_chunk,
+    )
+    MeshManager()
+    mesh = MeshManager.get_mesh()
+    tokens = np.random.RandomState(0).randint(
+        0, config["vocab_size"], size=(1, args.micro_bs, args.seq + 1)
+    ).astype(np.int32)
+
+    policies = [p for p in REMAT_AB_POLICIES if p != "offload_dots" or pinned_host_supported()]
+    if len(policies) < len(REMAT_AB_POLICIES):
+        print(
+            json.dumps({"bench": "train_fast_path", "policy": "offload_dots",
+                        "skipped": "no pinned_host memory space on this backend"}),
+            flush=True,
+        )
+    baseline = {}
+    for policy in policies:
+        wrapper = ModelWrapperForPretraining(
+            mode=Mode.training,
+            pretrained_config=config,
+            dtype=args.dtype,
+            sequence_length=args.seq,
+            attention_implementation=(
+                AttentionImplementation.flash_attention_2
+                if backend == "tpu"
+                else AttentionImplementation.sdpa
+            ),
+            zero_stage=3,
+            gradient_checkpointing_args={"checkpoint_every": args.ckpt or 1, "policy": policy},
+        )
+        sched = get_scheduler(10, 0, None, 1000, LRDecaySchedule.cosine, 0.1, base_lr=3e-4)
+        opt = get_optimizer(
+            "TorchAdamW", {"weight_decay": 0.1, "betas": (0.9, 0.95), "eps": 1e-10}, sched
+        )
+        state, _ = create_sharded_train_state(wrapper, opt, mesh, jax.random.PRNGKey(0))
+        step_fn = make_train_step(
+            lambda params, micro, rng, fp8_state=None: wrapper.loss(
+                params, micro["text"], train=True, fp8_state=fp8_state
+            ),
+            opt,
+        )
+        with mesh:
+            jit_step = jax.jit(step_fn, donate_argnums=0)
+            batch = {
+                "text": jax.device_put(
+                    jnp.asarray(tokens), named_sharding(None, ("dp", "fsdp"))
+                )
+            }
+            lowered = jit_step.lower(state, batch, jax.random.PRNGKey(1))
+            compiled = lowered.compile()
+            temp_bytes = None
+            try:
+                mem = compiled.memory_analysis()
+                if mem is not None:
+                    temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0))
+            except Exception:
+                pass
+            state, window_times = run_timed_windows(
+                jit_step, state, batch, jax.random.PRNGKey(1), args.steps,
+                windows=args.windows,
+            )
+        step_ms = float(np.median(window_times)) * 1e3
+        peak_bytes = None
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and stats.get("peak_bytes_in_use"):
+                peak_bytes = int(stats["peak_bytes_in_use"])
+        except Exception:
+            pass
+        tflops = get_model_tflops(
+            wrapper.config, args.micro_bs, args.seq,
+            gradient_checkpointing_method="block",
+            gradient_checkpointing_args={"checkpoint_every": args.ckpt or 1, "policy": policy},
+        )
+        mfu = tflops / (step_ms / 1e3) / jax.device_count() / _PEAK_TFLOPS.get(backend, 100.0)
+        if policy == "full":
+            baseline = {"step_ms": step_ms, "temp_bytes": temp_bytes}
+        line = {
+            "bench": "train_fast_path",
+            "policy": policy,
+            "backend": backend,
+            "ckpt": args.ckpt or 1,
+            "fused_loss": args.fused_loss,
+            "step_ms": round(step_ms, 2),
+            "mfu": round(mfu, 4),
+            "train_step_hbm_high_water": temp_bytes,
+            "peak_bytes_in_use": peak_bytes,
+            "train_step_time_ratio": (
+                round(baseline["step_ms"] / step_ms, 3) if baseline.get("step_ms") else None
+            ),
+            "hbm_vs_full": (
+                round(temp_bytes / baseline["temp_bytes"], 3)
+                if temp_bytes and baseline.get("temp_bytes")
+                else None
+            ),
+        }
+        print(json.dumps(line), flush=True)
 
 
 def run_kernel_ab(args) -> None:
@@ -271,10 +457,17 @@ def main() -> None:
     p.add_argument("--kernel_families", type=str, default=None,
                    help="comma list of families for --kernels "
                         f"(default: {','.join(KERNEL_AB_FAMILIES)})")
+    p.add_argument("--remat", action="store_true",
+                   help="remat-policy A/B mode: one train_fast_path JSON line per "
+                        f"policy ({','.join(REMAT_AB_POLICIES)}) with step-time ratio "
+                        "and compiled HBM high-water vs the full policy")
     args = p.parse_args()
 
     if args.kernels:
         run_kernel_ab(args)
+        return
+    if args.remat:
+        run_remat_ab(args)
         return
 
     if args.splash:

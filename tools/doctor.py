@@ -145,8 +145,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"sharding derivation failed (report shows unsharded sizes): {error}")
 
     model_tflops = None
+    remat = None
     sequence_length = getattr(model, "sequence_length", None)
     if args.training_parameters is not None and sequence_length:
+        from dolomite_engine_tpu.train_utils import estimate_remat_activation_bytes
+
         model_tflops = get_model_tflops(
             model.config,
             batch_size=args.training_parameters.micro_batch_size
@@ -155,12 +158,24 @@ def main(argv: list[str] | None = None) -> int:
             gradient_checkpointing_method=args.distributed_args.gradient_checkpointing_method,
             gradient_checkpointing_args=args.distributed_args.gradient_checkpointing_args,
         )
+        # active remat policy + per-replica activation-HBM estimate vs `full`, next to
+        # the state-HBM estimate — the pre-flight answer to "will activations fit, and
+        # which policy knob moves them"
+        remat = estimate_remat_activation_bytes(
+            model.config,
+            batch_size=args.training_parameters.micro_batch_size,
+            sequence_length=sequence_length,
+            gradient_checkpointing_method=args.distributed_args.gradient_checkpointing_method,
+            gradient_checkpointing_args=args.distributed_args.gradient_checkpointing_args,
+            dtype_bytes=jnp.dtype(model.dtype).itemsize,
+        )
 
     report = build_model_report(
         params_tree,
         opt_state=opt_tree,
         model_tflops_per_step=model_tflops,
         cost_analysis=_forward_cost_analysis(model, abstract_params, args),
+        remat=remat,
     )
     if mesh is not None and report.get("mesh") is None:
         report["mesh"] = {

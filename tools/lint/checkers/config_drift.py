@@ -30,6 +30,21 @@ _ARGS_REL = "dolomite_engine_tpu/arguments.py"
 # static model_fields inspection cannot see these remappings
 _BEFORE_VALIDATOR_ALIASES = {"LRSchedulerArgs": {"lr_schedule"}}
 
+# plain-`dict` arg fields with a KNOWN key vocabulary: pydantic model_fields sees only
+# `dict`, so without this table a typo'd key inside them (the seed of many wasted pod
+# claims: `gradient_checkpointing_args: {polcy: save_dots}`) passes lint and fails at
+# run time — or worse, silently trains with the default policy. Values: allowed keys,
+# plus optional per-key value vocabularies.
+_DICT_FIELD_KEYS: dict[tuple[str, str], dict] = {
+    ("DistributedArgs", "gradient_checkpointing_args"): {
+        "keys": {"checkpoint_every", "block_frequency", "checkpoint_policy", "policy"},
+        "values": {
+            # mirror models/gpt_dolomite.REMAT_POLICY_NAMES (asserted in tests/lint)
+            "policy": {"full", "save_dots", "save_attention_out", "offload_dots"},
+        },
+    },
+}
+
 
 def _config_root_class(filename: str, arguments_module) -> type:
     name = os.path.basename(filename)
@@ -137,6 +152,31 @@ class ConfigDriftChecker(Checker):
                         f"'{dotted}' is not a field of {model_cls.__name__}",
                     )
                 )
+                continue
+            vocab = _DICT_FIELD_KEYS.get((model_cls.__name__, key))
+            if vocab is not None and isinstance(value, dict):
+                for sub_key, sub_value in value.items():
+                    if sub_key not in vocab["keys"]:
+                        findings.append(
+                            Finding(
+                                "config-unknown-field",
+                                rel,
+                                _key_line(lines, sub_key),
+                                f"'{prefix}{key}.{sub_key}' is not a known "
+                                f"{key} key (expected one of {sorted(vocab['keys'])})",
+                            )
+                        )
+                    elif sub_value not in vocab.get("values", {}).get(sub_key, {sub_value}):
+                        findings.append(
+                            Finding(
+                                "config-unknown-field",
+                                rel,
+                                _key_line(lines, sub_key),
+                                f"'{prefix}{key}.{sub_key}: {sub_value}' is not a valid "
+                                f"value (expected one of "
+                                f"{sorted(vocab['values'][sub_key])})",
+                            )
+                        )
                 continue
             models = _base_args_models(fields[key].annotation)
             if not models:

@@ -120,6 +120,23 @@ def format_model_report(report: dict) -> list[str]:
         else:
             memory_line += " (device capacity not detected)"
         lines.append(memory_line)
+    remat = report.get("remat")
+    if remat:
+        remat_line = (
+            f"remat: policy {remat.get('policy', 'full')} "
+            f"(checkpoint_every {remat.get('checkpoint_every', 0)})"
+        )
+        if remat.get("activation_bytes_per_replica") is not None:
+            remat_line += (
+                f", ~{_format_bytes(remat['activation_bytes_per_replica'])} saved "
+                f"activations/replica ({'+' if remat.get('delta_vs_full_bytes', 0) >= 0 else ''}"
+                f"{_format_bytes(remat.get('delta_vs_full_bytes', 0))} vs full)"
+            )
+        if remat.get("host_offload_bytes_per_replica"):
+            remat_line += (
+                f", {_format_bytes(remat['host_offload_bytes_per_replica'])} offloaded to host"
+            )
+        lines.append(remat_line)
     if report.get("model_tflops_per_step"):
         lines.append(f"analytic model TFLOPs/step/group: {report['model_tflops_per_step']:.4g}")
     cost = report.get("cost_analysis")
