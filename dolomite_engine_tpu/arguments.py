@@ -558,6 +558,11 @@ class TelemetryArgs(BaseArgs):
     # per-device peak TFLOPs for MFU; None auto-detects from device_kind (TPU v2-v6e table,
     # utils/telemetry.py), or set DOLOMITE_PEAK_TFLOPS_PER_DEVICE
     peak_tflops_per_device: float | None = None
+    # capture the jitted train step's compiled-program perf signature at run start and
+    # write it as a `program_signature` record (utils/program_signature.py): cost flops,
+    # memory_analysis buffer breakdown, donation count, HLO features. Costs ONE extra
+    # AOT compile of the train step before the loop, hence off by default
+    program_signatures: bool = False
     # training health monitor: per-layer-group tensor stats, anomaly detection, crash
     # flight recorder (stats collection off by default; flight recorder on)
     health: HealthArgs = HealthArgs()

@@ -242,6 +242,44 @@ def train(
         )
     )
 
+    if args.logging_args.telemetry.program_signatures:
+        # self-report what compiled (docs/OBSERVABILITY.md "Perf ledger"): AOT-compile
+        # the train step on the run's exact batch shape/sharding and write its perf
+        # signature — temp-HBM high water, donation, cost flops, HLO features — as a
+        # `program_signature` record. One extra compile, hence behind the flag.
+        import contextlib
+
+        from .parallel.mesh import named_sharding
+        from .utils.program_signature import (
+            capture_jit_signature,
+            emit_program_signature_record,
+        )
+
+        rng_example = (
+            jax_rng if jax_rng is not None else jax.random.PRNGKey(args.random_args.seed)
+        )
+        with mesh if mesh is not None else contextlib.nullcontext():
+            # the loader's step batch: accum stacked GLOBAL micros (rows = micro_bs x
+            # dp world, the shape `samples_per_step` accounts), batch dim over the data
+            # axes — the same layout DispatchingDataLoader places
+            batch_struct = {
+                "text": jax.ShapeDtypeStruct(
+                    (
+                        gradient_accumulation_steps,
+                        micro_batch_size * dp_world_size,
+                        sequence_length + 1,
+                    ),
+                    jnp.int32,
+                    sharding=(
+                        named_sharding(None, ("dp", "fsdp")) if mesh is not None else None
+                    ),
+                )
+            }
+            signature = capture_jit_signature(
+                train_step, (state, batch_struct, rng_example), name="train_step"
+            )
+        emit_program_signature_record(telemetry, "pretrain", {"train_step": signature})
+
     if jax_rng is None:
         jax_rng = jax.random.PRNGKey(args.random_args.seed)
 

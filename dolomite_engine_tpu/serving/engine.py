@@ -56,7 +56,12 @@ from flax import linen as nn
 
 from ..ops.pallas import active_kernel_backends
 from ..ops.sampling import sample_tokens_vectorized, speculative_accept
-from ..utils.telemetry import get_telemetry
+from ..utils.program_signature import (
+    ProgramSignature,
+    capture_jit_signature,
+    emit_program_signature_record,
+)
+from ..utils.telemetry import Telemetry, get_telemetry
 from ..utils.tracing import RequestTrace
 from .kv_cache import TRASH_PAGE, HostSwapPool, PagedKVCachePool, SlotKVCachePool
 from .prefix_cache import PrefixCache, PrefixMatch
@@ -279,6 +284,11 @@ class ServingEngine:
             emits one ``trace`` telemetry record at finish. Off by default and
             zero-cost when off: no trace objects exist, no extra records are written,
             outputs and compile counts are byte-identical (asserted in tests).
+        signature_records: self-report the compiled programs: the first ``serving``
+            telemetry record emitted after any program traced also writes one
+            ``program_signature`` record (utils/program_signature.py; lowering-only —
+            cost, donation, HLO features — so no extra compiles). Off by default: the
+            lowering re-trace is not free on large models.
         prefill_only: run this engine as a disaggregation PrefillWorker (paged mode
             only): requests are admitted and chunk-prefilled as usual, the first token
             streams out, but instead of decoding, finished prefills park for
@@ -321,6 +331,7 @@ class ServingEngine:
         replica_id: int | None = None,
         prefill_only: bool = False,
         trace_requests: bool = False,
+        signature_records: bool = False,
     ) -> None:
         if mesh is not None and sharding_rules is None:
             raise ValueError(
@@ -387,6 +398,11 @@ class ServingEngine:
         self.replica_id = replica_id
         self.prefill_only = prefill_only
         self.trace_requests = trace_requests
+        self.signature_records = signature_records
+        # program name -> (jitted fn, abstract example args), recorded at each program's
+        # first invocation so `program_signatures()` can re-lower the exact same shapes
+        self._program_records: dict[str, tuple[Any, tuple]] = {}
+        self._signatures_emitted = False
         # which backend the chunked-prefill attention lowers through — stamped on
         # prefill_chunk trace spans so a timeline attributes compute to the kernel tier
         self._prefill_backend = active_kernel_backends().get("prefill_attention", "xla")
@@ -795,6 +811,56 @@ class ServingEngine:
         preempt/resume churn must not grow this once the buckets are warm."""
         return sum(int(fn._cache_size()) for fn in self._chunk_fns.values())
 
+    # ---------------------------------------------------------- program signatures
+
+    def _note_program(self, name: str, fn: Any, args: tuple) -> None:
+        """Record a jitted program's example arg shapes at its first invocation (one
+        dict lookup per call afterwards). Shapes are static for an engine's lifetime,
+        so the recorded abstract args reproduce exactly the program that served."""
+        if name in self._program_records:
+            return
+        sharded = self.mesh is not None
+        self._program_records[name] = (
+            fn,
+            jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=x.sharding if sharded else None
+                ),
+                args,
+            ),
+        )
+
+    def program_signatures(
+        self, compile: bool = True, names: tuple[str, ...] | None = None
+    ) -> dict[str, ProgramSignature]:
+        """Perf signatures of every jitted program this engine has run (decode, verify,
+        chunk-prefill and prefill buckets), re-lowered from the recorded example shapes
+        under the engine's mesh scope — the one accessor `tools/perf_ledger.py` and the
+        telemetry record read instead of per-program plumbing. Each signature carries
+        its program's live compile count (`decode_compiles`-family parity is asserted
+        in tests). ``compile=False`` skips XLA compilation (no ``memory`` section);
+        ``names`` restricts capture to those programs (each capture re-compiles)."""
+        out: dict[str, ProgramSignature] = {}
+        with self._scope():
+            for name, (fn, abstract_args) in sorted(self._program_records.items()):
+                if names is not None and name not in names:
+                    continue
+                sig = capture_jit_signature(fn, abstract_args, name=name, compile=compile)
+                sig.compiles = int(fn._cache_size())
+                out[name] = sig
+        return out
+
+    def emit_program_signatures(self) -> None:
+        """Write the ``program_signature`` telemetry record for this engine's programs
+        (lowering-only signatures: cost/donation/HLO features, no extra compiles)."""
+        telemetry = get_telemetry()
+        if not isinstance(telemetry, Telemetry) or not self._program_records:
+            return
+        self._signatures_emitted = True
+        emit_program_signature_record(
+            telemetry, "serving_engine", self.program_signatures(compile=False)
+        )
+
     # ------------------------------------------------------------------ dense internals
 
     def _admit(self) -> None:
@@ -827,7 +893,8 @@ class ServingEngine:
                 "prefill", parent=tr.root, t0=t_adm, slot=slot, tokens=prompt_len, resume=False
             )
         t0 = time.perf_counter()
-        token, carry, prefill_caches = self._get_prefill_fn(bucket)(
+        prefill_fn = self._get_prefill_fn(bucket)
+        prefill_args = (
             self._variables,
             jnp.asarray(ids),
             jnp.asarray(mask),
@@ -838,6 +905,8 @@ class ServingEngine:
             jnp.asarray(top_k, jnp.int32),
             jnp.asarray(top_p, jnp.float32),
         )
+        self._note_program(f"prefill[b={bucket}]", prefill_fn, prefill_args)
+        token, carry, prefill_caches = prefill_fn(*prefill_args)
         self.pool.write_prefill(slot, prefill_caches, prompt_len)
         first_token = int(token)  # host fetch: forces completion, ends the TTFT clock
         self.stats.prefill_seconds += time.perf_counter() - t0
@@ -873,7 +942,7 @@ class ServingEngine:
     def _decode_once(self) -> None:
         t0 = time.perf_counter()
         active = list(self._slot_states.keys())
-        caches, next_tokens, new_rngs = self._decode_step(
+        decode_args = (
             self._variables,
             self.pool.caches,
             jnp.asarray(self._tokens),
@@ -884,6 +953,8 @@ class ServingEngine:
             jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
+        self._note_program("decode", self._decode_step, decode_args)
+        caches, next_tokens, new_rngs = self._decode_step(*decode_args)
         self.pool.caches = caches
         tokens = np.asarray(next_tokens)  # host fetch: the streaming sync point
         self._rngs = np.array(new_rngs)  # copy: slots mutate their key at admission
@@ -1356,7 +1427,8 @@ class ServingEngine:
 
             do_sample, temperature, top_k, top_p = task.encoded
             t0 = time.perf_counter()
-            result = self._get_chunk_fn(width, samples)(
+            chunk_fn = self._get_chunk_fn(width, samples)
+            chunk_args = (
                 self._variables,
                 self.pool.caches,
                 jnp.asarray(self.pool.page_table[slot : slot + 1]),
@@ -1370,6 +1442,10 @@ class ServingEngine:
                 jnp.asarray(top_k, jnp.int32),
                 jnp.asarray(top_p, jnp.float32),
             )
+            self._note_program(
+                f"chunk[w={width},final={bool(samples)}]", chunk_fn, chunk_args
+            )
+            result = chunk_fn(*chunk_args)
             if samples:
                 self.pool.caches, token, carry = result
                 first_token = int(token)  # host fetch: ends the TTFT clock
@@ -1473,7 +1549,7 @@ class ServingEngine:
             lengths[slot] = int(self.pool.lengths[slot])
 
         t0 = time.perf_counter()
-        caches, next_tokens, new_rngs = self._decode_step(
+        decode_args = (
             self._variables,
             self.pool.caches,
             jnp.asarray(table),
@@ -1485,6 +1561,8 @@ class ServingEngine:
             jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
+        self._note_program("decode", self._decode_step, decode_args)
+        caches, next_tokens, new_rngs = self._decode_step(*decode_args)
         self.pool.caches = caches
         tokens = np.asarray(next_tokens)  # host fetch: the streaming sync point
         self._rngs = np.array(new_rngs)
@@ -1572,7 +1650,7 @@ class ServingEngine:
         tokens[:, 1:] = drafts
         w0 = self.scheduler.clock()
         t0 = time.perf_counter()
-        caches, accepted, bonus, new_rngs = self._verify_step(
+        verify_args = (
             self._variables,
             self.pool.caches,
             jnp.asarray(table),
@@ -1585,6 +1663,8 @@ class ServingEngine:
             jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
+        self._note_program("verify", self._verify_step, verify_args)
+        caches, accepted, bonus, new_rngs = self._verify_step(*verify_args)
         self.pool.caches = caches
         accepted = np.asarray(accepted)  # host fetch: the streaming sync point
         bonus = np.asarray(bonus)
@@ -1605,7 +1685,7 @@ class ServingEngine:
         tokens[:, 1:] = drafts
         w0 = self.scheduler.clock()
         t0 = time.perf_counter()
-        caches, accepted, bonus, new_rngs = self._verify_step(
+        verify_args = (
             self._variables,
             self.pool.caches,
             jnp.asarray(tokens),
@@ -1617,6 +1697,8 @@ class ServingEngine:
             jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
+        self._note_program("verify", self._verify_step, verify_args)
+        caches, accepted, bonus, new_rngs = self._verify_step(*verify_args)
         self.pool.caches = caches
         accepted = np.asarray(accepted)
         bonus = np.asarray(bonus)
@@ -1914,6 +1996,9 @@ class ServingEngine:
         telemetry = get_telemetry()
         stats = self.stats
         self._last_record_step = self._step_count
+        if self.signature_records and not self._signatures_emitted and self._program_records:
+            # engine-build self-report, once, lazily (programs trace on first use)
+            self.emit_program_signatures()
         telemetry.gauge("serving/queue_depth", self.scheduler.queue_depth)
         telemetry.gauge("serving/slot_occupancy", self.pool.occupancy)
         kv_bytes = round(self.pool.kv_bytes_per_token, 2)

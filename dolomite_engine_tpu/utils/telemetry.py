@@ -163,6 +163,14 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
     # tools/trace_export.py renders Perfetto timelines and tools/trace_analyze.py the
     # critical-path TTFT attribution from these records.
     "trace": ("trace_id", "request_id", "spans"),
+    # compiled-program perf signatures (utils/program_signature.py): the run self-reports
+    # what XLA built for its hot jitted programs — cost_analysis flops/bytes, donation
+    # count, HLO features, and (when captured with compile=True) the memory_analysis
+    # buffer breakdown. `source` says which subsystem captured ("pretrain",
+    # "serving_engine"); `programs` is a list of ProgramSignature.to_json() dicts.
+    # tools/perf_ledger.py gates the same facts against PERF_LEDGER.json offline;
+    # tools/telemetry_summary.py renders the "programs:" line.
+    "program_signature": ("source", "platform", "programs"),
 }
 
 # every literal counter name used through the registry; `count(..., event=True)` names must

@@ -998,9 +998,12 @@ def test_fused_ce_moe_aux_loss_combination():
 
 def test_fused_ce_peak_logits_memory_is_o_chunk():
     """Acceptance: the chunked lowering never materializes a [B*S, V]-sized logits
-    buffer — asserted on the jitted HLO text (the unchunked lowering must contain it,
-    the chunked one at most the [B, chunk, V] tile)."""
+    buffer — asserted through the shared perf-signature HLO-feature API
+    (utils/program_signature.py, the same checks `tools/perf_ledger.py` gates on):
+    the unchunked grad program must contain the full [B, S, V] tile, the chunked one
+    must not (at most the [B, chunk, V] scan tile)."""
     from dolomite_engine_tpu.ops.loss import causal_lm_loss, fused_linear_cross_entropy
+    from dolomite_engine_tpu.utils.program_signature import capture_program_signature
 
     B, S, H, V = 2, 64, 16, 199
     hidden = jax.random.normal(jax.random.PRNGKey(0), (B, S, H), jnp.float32)
@@ -1016,14 +1019,20 @@ def test_fused_ce_peak_logits_memory_is_o_chunk():
             h, t, labels, chunk_size=chunk, compute_dtype=jnp.float32
         )
 
-    full_shape = f"{B}x{S}x{V}xf32"
-    chunk_shape = f"{B}x{chunk}x{V}xf32"
-    # forward AND backward: grad of the loss is where remat pressure lives
-    text_unchunked = jax.jit(jax.grad(unchunked, argnums=(0, 1))).lower(hidden, table).as_text()
-    text_chunked = jax.jit(jax.grad(chunked, argnums=(0, 1))).lower(hidden, table).as_text()
-    assert full_shape in text_unchunked  # the reference really does build full logits
-    assert full_shape not in text_chunked
-    assert chunk_shape in text_chunked  # ...while the chunk tile exists
+    checks = {"full_logits": ((B, S, V), "f32"), "chunk_logits": ((B, chunk, V), "f32")}
+    # forward AND backward: grad of the loss is where remat pressure lives.
+    # compile=False: the assertion is about the lowering, not the buffer assignment
+    sig_unchunked = capture_program_signature(
+        jax.grad(unchunked, argnums=(0, 1)), hidden, table,
+        name="ce_unchunked_grad", compile=False, shape_checks=checks,
+    )
+    sig_chunked = capture_program_signature(
+        jax.grad(chunked, argnums=(0, 1)), hidden, table,
+        name="ce_chunked_grad", compile=False, shape_checks=checks,
+    )
+    assert sig_unchunked.hlo["checks"]["full_logits"]  # the reference builds full logits
+    assert not sig_chunked.hlo["checks"]["full_logits"]
+    assert sig_chunked.hlo["checks"]["chunk_logits"]  # ...while the chunk tile exists
 
 
 # ------------------------------------------------------------------- fused_rope_qkv

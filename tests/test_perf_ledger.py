@@ -1,0 +1,478 @@
+"""Perf-ledger tests: signature determinism, tolerance math, baseline round-trip through
+the `tools/perf_ledger.py` CLI, planted-regression detection (extra decode compile,
+broken train-step donation, temp-bytes inflation), and `ServingEngine.program_signatures()`
+parity with the compile-count properties.
+
+The CLI tests run on a `--programs` subset against a tmp ledger captured in-process, so
+the committed `PERF_LEDGER.json` (whose numbers depend on the capturing environment's XLA
+flags) is never compared against the test process's differently-flagged XLA.
+"""
+
+import copy
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.utils.program_signature import (
+    DEFAULT_TOLERANCES,
+    ProgramSignature,
+    capture_program_signature,
+    diff_programs,
+    diff_signatures,
+    emit_program_signature_record,
+    hlo_has_shape,
+)
+
+from .test_commons import get_dense_test_config
+
+
+def _toy(x, y):
+    return jnp.dot(x, y) + jnp.tanh(x).sum()
+
+
+def _toy_args():
+    return (
+        jax.ShapeDtypeStruct((8, 16), jnp.float32),
+        jax.ShapeDtypeStruct((16, 4), jnp.float32),
+    )
+
+
+# ------------------------------------------------------------------- signatures
+
+
+def test_signature_determinism_across_two_captures():
+    checks = {"out": ((8, 4), "f32"), "absent": ((3, 3, 3), "f32")}
+    first = capture_program_signature(_toy, *_toy_args(), name="toy", shape_checks=checks)
+    second = capture_program_signature(_toy, *_toy_args(), name="toy", shape_checks=checks)
+    assert first.to_json() == second.to_json()
+    # the signature is JSON-stable (what PERF_LEDGER.json round-trips)
+    assert json.loads(json.dumps(first.to_json())) == second.to_json()
+
+
+def test_signature_contents_and_hlo_features():
+    sig = capture_program_signature(
+        _toy,
+        *_toy_args(),
+        name="toy",
+        shape_checks={"out": ((8, 4), "f32"), "absent": ((3, 3, 3), "f32")},
+    )
+    assert sig.platform == jax.default_backend()
+    assert sig.compiled and sig.cost["flops"] > 0
+    assert sig.memory["argument_size_in_bytes"] == (8 * 16 + 16 * 4) * 4
+    assert sig.hlo["checks"] == {"out": True, "absent": False}
+    assert sig.hlo["op_histogram"].get("dot_general") == 1
+    assert sig.hlo["largest_buffer"] == {"shape": "8x16xf32", "bytes": 512}
+    # round-trip through the dataclass
+    assert ProgramSignature.from_json(sig.to_json()).to_json() == sig.to_json()
+
+
+def test_capture_without_compile_skips_memory():
+    sig = capture_program_signature(_toy, *_toy_args(), name="toy", compile=False)
+    assert not sig.compiled and sig.memory == {}
+    assert sig.cost["flops"] > 0  # lowering-only cost analysis still lands
+
+
+def test_donation_is_counted():
+    donated = capture_program_signature(
+        lambda x: x + 1.0,
+        jax.ShapeDtypeStruct((8, 8), jnp.float32),
+        name="donated",
+        jit_kwargs={"donate_argnums": (0,)},
+    )
+    plain = capture_program_signature(
+        lambda x: x + 1.0, jax.ShapeDtypeStruct((8, 8), jnp.float32), name="plain"
+    )
+    assert donated.donation["donated_inputs"] == 1
+    assert plain.donation["donated_inputs"] == 0
+
+
+def test_hlo_has_shape_spells_both_dialects():
+    assert hlo_has_shape("... tensor<2x3xf32> ...", (2, 3), "f32")
+    assert hlo_has_shape("... f32[2,3]{1,0} ...", (2, 3), "f32")
+    assert hlo_has_shape("... s32[2,3] ...", (2, 3), "i32")  # HLO spells ints s32
+    assert not hlo_has_shape("... tensor<2x4xf32> ...", (2, 3), "f32")
+
+
+# --------------------------------------------------------------- tolerance math
+
+
+def _sig_dict(**overrides):
+    base = {
+        "name": "p",
+        "platform": "cpu",
+        "compiled": True,
+        "cost": {"flops": 1000.0, "bytes_accessed": 5000.0},
+        "memory": {"temp_size_in_bytes": 100000, "argument_size_in_bytes": 64},
+        "donation": {"donated_inputs": 3},
+        "in_sharding_specs": ["spec_a"],
+        "out_sharding_specs": ["spec_a"],
+        "hlo": {
+            "op_histogram": {"add": 2},
+            "largest_buffer": {"shape": "8x16xf32", "bytes": 512},
+            "checks": {"full_logits": False},
+        },
+        "compiles": 1,
+    }
+    for path, value in overrides.items():
+        section, _, key = path.partition(".")
+        if key:
+            base[section] = {**base[section], key: value}
+        else:
+            base[section] = value
+    return base
+
+
+def test_tolerance_within_passes_beyond_fails():
+    base = _sig_dict()
+    within = _sig_dict(**{"memory.temp_size_in_bytes": 101500})  # +1.5% < 2%
+    assert diff_signatures(base, within) == []
+    beyond = _sig_dict(**{"memory.temp_size_in_bytes": 103000})  # +3% > 2%
+    drifts = diff_signatures(base, beyond)
+    assert [d.metric for d in drifts] == ["memory.temp_size_in_bytes"]
+    assert "memory.temp_size_in_bytes" in str(drifts[0])
+    assert "103000" in str(drifts[0])  # the delta is named, not just "failed"
+
+
+def test_exact_metrics_gate_any_change():
+    base = _sig_dict()
+    for path, value in (
+        ("donation.donated_inputs", 2),
+        ("compiles", 2),
+        ("memory.argument_size_in_bytes", 65),
+    ):
+        drifts = diff_signatures(base, _sig_dict(**{path: value}))
+        assert [d.metric for d in drifts] == [path], path
+
+
+def test_bool_check_flip_and_missing_metric_are_drifts():
+    base = _sig_dict()
+    flipped = copy.deepcopy(base)
+    flipped["hlo"]["checks"] = {"full_logits": True}
+    assert [d.metric for d in diff_signatures(base, flipped)] == ["hlo.checks.full_logits"]
+    missing = copy.deepcopy(base)
+    del missing["memory"]["temp_size_in_bytes"]
+    drifts = diff_signatures(base, missing)
+    assert [d.metric for d in drifts] == ["memory.temp_size_in_bytes"]
+    assert drifts[0].current is None
+
+
+def test_custom_and_skip_tolerances():
+    base = _sig_dict()
+    doubled = _sig_dict(**{"memory.temp_size_in_bytes": 200000})
+    assert diff_signatures(base, doubled, {"memory.temp_size_in_bytes": 1.5}) == []
+    assert diff_signatures(base, doubled, {"memory.temp_size_in_bytes": None}) == []
+    # flops has 1% by default; tightening to exact flags a 0.5% move
+    nudged = _sig_dict(**{"cost.flops": 1005.0})
+    assert diff_signatures(base, nudged) == []
+    assert [d.metric for d in diff_signatures(base, nudged, {"cost.flops": 0.0})] == [
+        "cost.flops"
+    ]
+    # informational-by-default metrics never gate
+    assert DEFAULT_TOLERANCES["hlo.largest_buffer.shape"] is None
+
+
+def test_diff_programs_missing_and_new():
+    base = {"a": _sig_dict(), "b": _sig_dict()}
+    cur = {"a": _sig_dict(), "c": _sig_dict()}
+    drifts, notes = diff_programs(base, cur)
+    assert [(d.program, d.metric, d.current) for d in drifts] == [("b", "program", "missing")]
+    assert notes and "c" in notes[0]
+
+
+# ------------------------------------------------------------- CLI round-trip
+
+
+def test_cli_baseline_roundtrip_and_planted_temp_inflation(tmp_path, capsys):
+    from tools import perf_ledger
+
+    ledger = str(tmp_path / "ledger.json")
+    assert perf_ledger.main(["--update", "--programs", "fused_ce", "--ledger", ledger]) == 0
+    # clean tree: --check against the just-captured baseline passes
+    assert perf_ledger.main(["--check", "--programs", "fused_ce", "--ledger", ledger]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out
+
+    # plant a temp-bytes regression: baseline pretends temp HBM used to be 40% smaller
+    with open(ledger) as f:
+        payload = json.load(f)
+    entry = payload["platforms"][jax.default_backend()]
+    grad = entry["programs"]["fused_ce_chunk_grad"]
+    grad["memory"]["temp_size_in_bytes"] = int(
+        grad["memory"]["temp_size_in_bytes"] * 0.6
+    )
+    with open(ledger, "w") as f:
+        json.dump(payload, f)
+    assert perf_ledger.main(["--check", "--programs", "fused_ce", "--ledger", ledger]) == 1
+    out = capsys.readouterr().out
+    assert "fused_ce_chunk_grad" in out and "memory.temp_size_in_bytes" in out
+
+    # environment skew downgrades the same drift to a warning unless --strict
+    entry["captured"]["jax"] = "0.0.0"
+    with open(ledger, "w") as f:
+        json.dump(payload, f)
+    assert perf_ledger.main(["--check", "--programs", "fused_ce", "--ledger", ledger]) == 0
+    assert "WARN" in capsys.readouterr().out
+    assert (
+        perf_ledger.main(
+            ["--check", "--strict", "--programs", "fused_ce", "--ledger", ledger]
+        )
+        == 1
+    )
+
+
+def test_cli_missing_platform_baseline_passes(tmp_path, capsys):
+    from tools import perf_ledger
+
+    ledger = str(tmp_path / "ledger.json")
+    with open(ledger, "w") as f:
+        json.dump({"schema": 1, "platforms": {"tpu": {"programs": {}}}}, f)
+    assert perf_ledger.main(["--check", "--ledger", ledger]) == 0
+    assert "no" in capsys.readouterr().out.lower()
+
+
+# ------------------------------------------------------------- engine programs
+
+
+@pytest.fixture(scope="module")
+def driven_engine():
+    """A tiny paged engine that has served two requests (decode + chunk programs traced),
+    shared across the engine-side tests (building it costs the compiles)."""
+    from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+    from dolomite_engine_tpu.serving import ServingEngine
+
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = ServingEngine(
+        model, params, num_slots=2, max_len=64, paged=True, page_size=8,
+        prefill_chunk_tokens=16,
+    )
+    rs = np.random.RandomState(0)
+    for _ in range(2):
+        engine.submit(
+            list(map(int, rs.randint(3, config.vocab_size, 12))), max_new_tokens=4
+        )
+    engine.drain()
+    return config, model, params, engine
+
+
+def test_engine_program_signatures_parity_with_compile_properties(driven_engine):
+    config, model, params, engine = driven_engine
+    signatures = engine.program_signatures(compile=False)
+    assert engine.decode_compiles == signatures["decode"].compiles == 1
+    chunk_compiles = sum(
+        sig.compiles for name, sig in signatures.items() if name.startswith("chunk[")
+    )
+    assert chunk_compiles == engine.chunk_compiles >= 1
+    assert engine.verify_compiles == 0 and not any(
+        name == "verify" for name in signatures
+    )
+    # lower-only capture: HLO/cost/donation present, no buffer assignment
+    decode = signatures["decode"]
+    assert decode.memory == {} and decode.cost.get("flops", 0) > 0
+    assert decode.donation["donated_inputs"] > 0  # the donated KV pool
+    assert decode.hlo["op_histogram"]
+
+
+def test_engine_verify_program_signature():
+    from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+    from dolomite_engine_tpu.serving import ServingEngine
+
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = ServingEngine(
+        model, params, num_slots=2, max_len=64, paged=True, page_size=8,
+        prefill_chunk_tokens=16, speculate_ngram=True, draft_k=2,
+    )
+    engine.submit(list(range(3, 15)), max_new_tokens=4)
+    engine.drain()
+    signatures = engine.program_signatures(compile=False, names=("verify",))
+    assert set(signatures) == {"verify"}
+    assert signatures["verify"].compiles == engine.verify_compiles == 1
+
+
+def test_check_catches_planted_extra_decode_compile(driven_engine):
+    """A REAL second decode-step compile (different token dtype through the same jit)
+    must turn `--check` red with the compile count named."""
+    from tools.perf_ledger import check_programs, current_env
+
+    config, model, params, engine = driven_engine
+    baseline_programs = {
+        name: sig.to_json()
+        for name, sig in engine.program_signatures(compile=False, names=("decode",)).items()
+    }
+    entry = {"captured": current_env(), "programs": baseline_programs}
+
+    code, lines = check_programs(
+        entry,
+        {n: s.to_json() for n, s in engine.program_signatures(
+            compile=False, names=("decode",)).items()},
+    )
+    assert code == 0, lines
+
+    # plant: run the decode program once more with int16 tokens — a genuinely new
+    # compiled variant of the same jit (caches copied: the jit donates argument 1)
+    fn, abstract_args = engine._program_records["decode"]
+    args = list(abstract_args)
+    args[1] = jax.tree.map(jnp.copy, engine.pool.caches)
+    concrete = [
+        jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype)
+            if isinstance(leaf, jax.ShapeDtypeStruct)
+            else leaf,
+            arg,
+        )
+        for arg in args
+    ]
+    concrete[3] = concrete[3].astype(jnp.int16)  # tokens: int32 -> int16
+    fn(*concrete)
+    assert engine.decode_compiles == 2
+
+    current = {
+        name: sig.to_json()
+        for name, sig in engine.program_signatures(compile=False, names=("decode",)).items()
+    }
+    code, lines = check_programs(entry, current)
+    assert code == 1
+    joined = "\n".join(lines)
+    assert "decode" in joined and "compiles" in joined and "1 -> 2" in joined
+
+
+def test_check_catches_broken_train_step_donation():
+    """Losing `donate_argnums` on the (stand-in) train step must turn the check red with
+    the donation metric named."""
+    from tools.perf_ledger import check_programs, current_env
+
+    def step(state, batch):
+        new = jax.tree.map(lambda p: p - 0.1 * batch.sum(), state)
+        return new, sum(jax.tree.leaves(jax.tree.map(jnp.sum, new)))
+
+    state = {"w": jax.ShapeDtypeStruct((32, 32), jnp.float32),
+             "b": jax.ShapeDtypeStruct((32,), jnp.float32)}
+    batch = jax.ShapeDtypeStruct((4, 32), jnp.float32)
+    donated = capture_program_signature(
+        step, state, batch, name="train_step", jit_kwargs={"donate_argnums": (0,)}
+    )
+    broken = capture_program_signature(step, state, batch, name="train_step")
+    assert donated.donation["donated_inputs"] == 2 and broken.donation["donated_inputs"] == 0
+
+    entry = {"captured": current_env(), "programs": {"train_step": donated.to_json()}}
+    code, lines = check_programs(entry, {"train_step": broken.to_json()})
+    assert code == 1
+    assert any("donation.donated_inputs" in line for line in lines)
+
+
+# --------------------------------------------------------- telemetry + summary
+
+
+def test_program_signature_record_and_summary_line(tmp_path):
+    from dolomite_engine_tpu.utils.telemetry import Telemetry
+
+    sink = tmp_path / "telemetry.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    donated = capture_program_signature(
+        lambda x: x * 2, jax.ShapeDtypeStruct((4, 4), jnp.float32),
+        name="decode", jit_kwargs={"donate_argnums": (0,)},
+    )
+    donated.compiles = 1
+    undonated = capture_program_signature(
+        lambda x: x * 2, jax.ShapeDtypeStruct((4, 4), jnp.float32), name="prefill[b=64]"
+    )
+    emit_program_signature_record(
+        telemetry, "serving_engine", {"decode": donated, "prefill[b=64]": undonated}
+    )
+    telemetry.close()
+
+    records = [json.loads(line) for line in sink.read_text().splitlines()]
+    sig_records = [r for r in records if r.get("kind") == "program_signature"]
+    assert len(sig_records) == 1
+    record = sig_records[0]
+    assert record["source"] == "serving_engine"
+    assert record["platform"] == jax.default_backend()
+    assert {p["name"] for p in record["programs"]} == {"decode", "prefill[b=64]"}
+
+    from tools.telemetry_summary import summarize
+
+    rendered = summarize(records)
+    line = next(ln for ln in rendered.splitlines() if ln.startswith("programs:"))
+    assert "2 captured" in line
+    assert "temp HBM high water" in line
+    assert "compiles decode=1" in line
+    assert "no donation" in line and "prefill[b=64]" in line
+
+
+def test_pretrain_flag_emits_train_step_signature(tmp_path, monkeypatch, eight_devices):
+    """`logging_args.telemetry.program_signatures: true` — the pretrain loop AOT-captures
+    the real train step and the record (with a memory section: compiled capture) lands in
+    the run's telemetry sink."""
+    import glob
+
+    from dolomite_engine_tpu import pretrain
+    from dolomite_engine_tpu.model_wrapper import base as mw_base
+
+    from .test_e2e_pretrain import _StubTokenizer, _training_args, _write_corpus
+
+    def _setup(self, tokenizer_name, additional_special_tokens):
+        self.tokenizer = _StubTokenizer()
+
+    monkeypatch.setattr(mw_base.ModelWrapper, "_setup_tokenizer", _setup)
+    prefix = _write_corpus(tmp_path)
+    args = _training_args(tmp_path, prefix, num_steps=2)
+    args.logging_args.telemetry.program_signatures = True
+    pretrain.main(args=args)
+
+    sinks = glob.glob(str(tmp_path / "ckpt" / "telemetry" / "*.jsonl"))
+    assert sinks
+    records = []
+    for sink in sinks:
+        with open(sink) as f:
+            records.extend(json.loads(line) for line in f if line.strip())
+    sig_records = [r for r in records if r.get("kind") == "program_signature"]
+    assert len(sig_records) == 1
+    record = sig_records[0]
+    assert record["source"] == "pretrain"
+    (program,) = record["programs"]
+    assert program["name"] == "train_step"
+    assert program["memory"]["temp_size_in_bytes"] > 0  # compiled capture
+    assert program["donation"]["donated_inputs"] > 0  # donate_argnums=0 on the step
+
+
+def test_engine_signature_records_emitted_once(tmp_path):
+    """`signature_records=True`: the first serving record after any program traced also
+    writes one program_signature record; off by default no record appears."""
+    from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+    from dolomite_engine_tpu.serving import ServingEngine
+    from dolomite_engine_tpu.utils.telemetry import (
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    sink = tmp_path / "serving.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engine = ServingEngine(
+            model, params, num_slots=2, max_len=64, paged=True, page_size=8,
+            prefill_chunk_tokens=16, signature_records=True,
+        )
+        engine.submit(list(range(3, 15)), max_new_tokens=3)
+        engine.drain()
+        engine.emit_serving_record()  # second record: signatures must not re-emit
+    finally:
+        uninstall_telemetry()
+        telemetry.close()
+
+    records = [json.loads(line) for line in sink.read_text().splitlines()]
+    sig_records = [r for r in records if r.get("kind") == "program_signature"]
+    assert len(sig_records) == 1
+    names = {p["name"] for p in sig_records[0]["programs"]}
+    assert "decode" in names and any(n.startswith("chunk[") for n in names)

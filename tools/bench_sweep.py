@@ -266,8 +266,10 @@ def run_remat_ab(args) -> None:
     """Per-remat-policy train-step A/B: one ``{"bench": "train_fast_path", ...}`` JSON
     line per policy with the step-time ratio and HBM high-water vs the ``full`` policy.
 
-    HBM high water comes from the compiled step's static buffer assignment
-    (``memory_analysis().temp_size_in_bytes``) so the line is meaningful on CPU too —
+    HBM high water comes from the compiled step's static buffer assignment (the
+    ``temp_size_in_bytes`` field of the step's perf signature,
+    ``utils/program_signature.capture_jit_signature`` — the same extraction
+    ``tools/perf_ledger.py`` gates on) so the line is meaningful on CPU too —
     live ``device.memory_stats()`` peaks ride along when the backend exposes them
     (TPU). Off-TPU the step-time column measures the CPU backend, not the claim; the
     ``backend`` field says which you got (the PR 11 bench resilience contract: a
@@ -283,6 +285,7 @@ def run_remat_ab(args) -> None:
     )
     from dolomite_engine_tpu.distributed import create_sharded_train_state
     from dolomite_engine_tpu.utils.jax_compat import pinned_host_supported
+    from dolomite_engine_tpu.utils.program_signature import capture_jit_signature
 
     backend = jax.default_backend()
     n_head = args.n_head or args.n_embd // 64
@@ -352,15 +355,12 @@ def run_remat_ab(args) -> None:
                     jnp.asarray(tokens), named_sharding(None, ("dp", "fsdp"))
                 )
             }
-            lowered = jit_step.lower(state, batch, jax.random.PRNGKey(1))
-            compiled = lowered.compile()
-            temp_bytes = None
-            try:
-                mem = compiled.memory_analysis()
-                if mem is not None:
-                    temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0))
-            except Exception:
-                pass
+            sig = capture_jit_signature(
+                jit_step,
+                (state, batch, jax.random.PRNGKey(1)),
+                name=f"train_step[policy={policy}]",
+            )
+            temp_bytes = sig.memory.get("temp_size_in_bytes")
             state, window_times = run_timed_windows(
                 jit_step, state, batch, jax.random.PRNGKey(1), args.steps,
                 windows=args.windows,

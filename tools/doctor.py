@@ -30,8 +30,9 @@ from telemetry_summary import format_model_report  # noqa: E402
 
 def _forward_cost_analysis(model, abstract_params, args) -> dict | None:
     """Best-effort FLOPs/bytes of ONE forward micro-batch from the staged computation
-    (`jax.stages.Lowered.cost_analysis`) — no compile, no execution. Pretraining only: the
-    token-window shape is declared in the config; finetune batch shapes come from data."""
+    (the lowering-only perf signature, `utils/program_signature.py` — no compile, no
+    execution). Pretraining only: the token-window shape is declared in the config;
+    finetune batch shapes come from data."""
     import jax
 
     sequence_length = getattr(model, "sequence_length", None)
@@ -41,19 +42,17 @@ def _forward_cost_analysis(model, abstract_params, args) -> dict | None:
     try:
         import jax.numpy as jnp
 
+        from dolomite_engine_tpu.utils.program_signature import capture_program_signature
+
         text = jax.ShapeDtypeStruct((micro_batch_size, sequence_length + 1), jnp.int32)
-        lowered = jax.jit(
-            lambda params, tokens: model.loss(params, tokens, rngs=None, train=False)
-        ).lower(abstract_params, text)
-        cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns one dict per computation
-            cost = cost[0] if cost else None
-        if not cost:
-            return None
-        out = {}
-        for key in ("flops", "bytes accessed"):
-            if cost.get(key):
-                out[key.replace(" ", "_")] = float(cost[key])
+        sig = capture_program_signature(
+            lambda params, tokens: model.loss(params, tokens, rngs=None, train=False),
+            abstract_params,
+            text,
+            name="forward_loss",
+            compile=False,
+        )
+        out = {k: v for k, v in sig.cost.items() if k in ("flops", "bytes_accessed")}
         return out or None
     except Exception as error:
         print(f"(cost analysis unavailable: {error!r})")

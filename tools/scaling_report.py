@@ -119,7 +119,11 @@ def _child(n: int, dp: int, fsdp: int, sp: int, tp: int) -> None:
         # mentions; fusion names like "all-reduce-fusion" are excluded by the word boundary
         counts[op] = len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo))
 
-    mem = compiled.memory_analysis()
+    # per-device memory columns come from the shared perf-signature extraction
+    # (utils/program_signature.py) — the same path tools/perf_ledger.py gates on
+    from dolomite_engine_tpu.utils.program_signature import extract_signature
+
+    sig = extract_signature(lowered, compiled, name=f"train_step[devices={n}]")
 
     # Evidence for the memory column: the largest PER-DEVICE buffers backing temp_size.
     # Parse the buffer-assignment dump (enabled by the parent via --xla_dump_to) so a
@@ -147,9 +151,9 @@ def _child(n: int, dp: int, fsdp: int, sp: int, tp: int) -> None:
                 "mesh": {"dp": dp, "fsdp": fsdp, "sp": sp, "tp": tp},
                 "compile_s": round(compile_s, 1),
                 "collectives": counts,
-                "peak_bytes": getattr(mem, "temp_size_in_bytes", None),
-                "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
-                "output_bytes": getattr(mem, "output_size_in_bytes", None),
+                "peak_bytes": sig.memory.get("temp_size_in_bytes"),
+                "argument_bytes": sig.memory.get("argument_size_in_bytes"),
+                "output_bytes": sig.memory.get("output_size_in_bytes"),
                 "top_temp_buffers": top_buffers,
             }
         )

@@ -153,6 +153,13 @@ def parse_args() -> argparse.Namespace:
         "(Perfetto) and tools/trace_analyze.py (critical-path TTFT attribution). Off "
         "by default; zero overhead when off",
     )
+    p.add_argument(
+        "--program-signatures",
+        action="store_true",
+        help="self-report the engine's compiled programs: the first serving telemetry "
+        "record also writes a `program_signature` record (cost/donation/HLO features, "
+        "utils/program_signature.py; docs/OBSERVABILITY.md 'Perf ledger')",
+    )
     p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
     p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
     p.add_argument("--seed", type=int, default=0)
@@ -266,6 +273,7 @@ def main() -> None:
             mesh=mesh,
             sharding_rules=rules,
             trace_requests=args.trace,
+            signature_records=args.program_signatures,
         )
         kwargs.update(overrides)
         return ServingEngine(model.model, params, **kwargs)

@@ -171,6 +171,7 @@ def summarize(records: list[dict]) -> str:
     servings = [r for r in records if r.get("kind") == "serving"]
     routers = [r for r in records if r.get("kind") == "router"]
     traces = [r for r in records if r.get("kind") == "trace"]
+    signatures = [r for r in records if r.get("kind") == "program_signature"]
 
     lines: list[str] = []
 
@@ -210,6 +211,41 @@ def summarize(records: list[dict]) -> str:
     # ---------------------------------------------------------------- model report
     if model_reports:
         lines.extend(format_model_report(model_reports[0]))
+        lines.append("")
+
+    # ------------------------------------------------------- compiled-program signatures
+    if signatures:
+        # one entry per (source, program) — the run's self-report of what compiled
+        # (utils/program_signature.py; gated offline by tools/perf_ledger.py)
+        programs: dict[str, dict] = {}
+        for record in signatures:
+            for prog in record.get("programs") or []:
+                programs[f"{record.get('source', '?')}:{prog.get('name', '?')}"] = prog
+        temps = [
+            temp
+            for prog in programs.values()
+            if (temp := (prog.get("memory") or {}).get("temp_size_in_bytes")) is not None
+        ]
+        compiles = {
+            name.rsplit(":", 1)[-1]: prog["compiles"]
+            for name, prog in sorted(programs.items())
+            if prog.get("compiles") is not None
+        }
+        undonated = sorted(
+            name
+            for name, prog in programs.items()
+            if not (prog.get("donation") or {}).get("donated_inputs")
+        )
+        parts = [f"programs: {len(programs)} captured"]
+        if temps:
+            parts.append(f"temp HBM high water {_format_bytes(max(temps))}")
+        if compiles:
+            parts.append(
+                "compiles " + ", ".join(f"{k}={v}" for k, v in compiles.items())
+            )
+        if undonated:
+            parts.append(f"no donation [{', '.join(undonated)}]")
+        lines.append(", ".join(parts))
         lines.append("")
 
     # ---------------------------------------------------------------- step times
