@@ -1,6 +1,6 @@
 """Distributed serving tier: TP-sharded replicas, prefill/decode disaggregation, router.
 
-Three layers over the single-process :class:`~dolomite_engine_tpu.serving.ServingEngine`
+Four layers over the single-process :class:`~dolomite_engine_tpu.serving.ServingEngine`
 (docs/SERVING.md "Distributed serving"):
 
 - :mod:`sharded` — run one engine's jitted prefill/decode/verify programs over a TP
@@ -12,10 +12,23 @@ Three layers over the single-process :class:`~dolomite_engine_tpu.serving.Servin
 - :mod:`router` — a thin router fronting N engine replicas: admission control and
   replica selection from the engines' own serving telemetry, with prefix-affinity
   routing so repeated prompts land where their pages already live.
+- :mod:`health` + :mod:`faults` — fleet fault tolerance (docs/FAULT_TOLERANCE.md
+  "Serving fleet"): replica health monitoring (healthy -> suspect -> dead), crash/wedge
+  recovery with bit-exact in-flight migration, drain/rejoin for rolling updates, and a
+  deterministic fault-injection seam that makes all of it testable.
 """
 
 from .disagg import DisaggregatedEngine, KVHandoff
-from .router import EngineReplica, Router, RouterStats, route_batch
+from .faults import Fault, FaultInjector, InjectedFault
+from .health import ReplicaHealth, ReplicaHealthMonitor
+from .router import (
+    DrainTimeoutError,
+    EngineReplica,
+    NoLiveReplicasError,
+    Router,
+    RouterStats,
+    route_batch,
+)
 from .sharded import (
     inference_mesh,
     inference_sharding_rules,
@@ -25,8 +38,15 @@ from .sharded import (
 
 __all__ = [
     "DisaggregatedEngine",
+    "DrainTimeoutError",
     "EngineReplica",
+    "Fault",
+    "FaultInjector",
+    "InjectedFault",
     "KVHandoff",
+    "NoLiveReplicasError",
+    "ReplicaHealth",
+    "ReplicaHealthMonitor",
     "Router",
     "RouterStats",
     "inference_mesh",

@@ -32,7 +32,7 @@ import numpy as np
 from ...utils.telemetry import get_telemetry
 from ..engine import ServingEngine
 from ..kv_cache import KVCacheList, PagedKVCachePool, TRASH_PAGE
-from ..scheduler import RequestState
+from ..scheduler import QueueFullError, RequestState
 
 
 def _copy_pages(dst_caches: KVCacheList, src_caches: KVCacheList, dst_index, src_index):
@@ -58,11 +58,18 @@ class KVHandoff:
     bookkeeping, host wall clock).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, fault_injector=None, replica_id: int = 0) -> None:
         self._copy_fn = jax.jit(_copy_pages, donate_argnums=(0,))
         self.transfers = 0
         self.last_latency_s = 0.0
         self._latency_sum = 0.0
+        # chaos seam (serving/cluster/faults.py): `EngineReplica` wires its injector
+        # here so a planned `handoff` fault fires at an exact transfer index; the off
+        # path is one None check, and a raise fires BEFORE any page copy — the
+        # destination pages stay unwritten, the replica's step raise is what the
+        # health monitor then judges
+        self.fault_injector = fault_injector
+        self.replica_id = replica_id
 
     @property
     def mean_latency_s(self) -> float:
@@ -75,6 +82,8 @@ class KVHandoff:
         dst_pool: PagedKVCachePool,
         dst_pages: list[int],
     ) -> None:
+        if self.fault_injector is not None:
+            self.fault_injector.on_transfer(self.replica_id)
         if src_pool.page_size != dst_pool.page_size:
             raise ValueError(
                 f"KV handoff needs equal page sizes, got {src_pool.page_size} -> "
@@ -180,6 +189,55 @@ class DisaggregatedEngine:
         self.prefill.emit_serving_record()
         for worker in self.workers:
             worker.emit_serving_record()
+
+    # -------------------------------------------------------------- crash migration
+
+    def inflight_request_ids(self) -> list[int]:
+        ids = set(self.prefill.inflight_request_ids())
+        for worker in self.workers:
+            ids.update(worker.inflight_request_ids())
+        return sorted(ids)
+
+    def release_inflight(self) -> list[RequestState]:
+        """Strip every unfinished request out of BOTH sides. A request caught mid-
+        handoff (adopted by a worker but its page transfer unfinished) appears in both
+        engines' slot tables — it is released once. All sides share the prefill
+        scheduler's seq space, so the merged (tier, seq) order is fleet-FCFS."""
+        released = self.prefill.release_inflight()
+        seen = {state.request.request_id for state in released}
+        for worker in self.workers:
+            for state in worker.release_inflight():
+                if state.request.request_id not in seen:
+                    seen.add(state.request.request_id)
+                    released.append(state)
+        released.sort(key=lambda s: (s.tier, s.seq))
+        return released
+
+    def adopt_inflight(self, state: RequestState) -> None:
+        """Adopt a request migrated from another replica. Fresh requests (no tokens
+        yet) re-enter through the prefill side like any arrival; mid-generation ones
+        go straight to a decode worker — decode workers are full paged engines, so the
+        recompute resume chunk-prefills the committed prefix there and decode
+        continues in place, skipping a pointless re-handoff."""
+        if not state.tokens:
+            self.prefill.adopt_inflight(state)
+            return
+        last_error: QueueFullError | None = None
+        for worker in sorted(self.workers, key=lambda w: (w.pool.occupancy, id(w))):
+            try:
+                worker.adopt_inflight(state)
+                return
+            except QueueFullError as error:
+                last_error = error
+        assert last_error is not None
+        raise last_error
+
+    def swap_params(self, params) -> None:
+        """Install new weights on the prefill engine and every decode worker (rolling
+        update while parked by `Router.drain_replica`)."""
+        self.prefill.swap_params(params)
+        for worker in self.workers:
+            worker.swap_params(params)
 
     # ------------------------------------------------------------------- internals
 

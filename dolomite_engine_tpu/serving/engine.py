@@ -176,6 +176,23 @@ def _percentile(samples: list[float], q: float) -> float | None:
     return ordered[rank]
 
 
+def _rederive_rng_carry(rng, steps: int) -> np.ndarray:
+    """Re-derive a slot's PRNG carry after ``steps`` consumed splits of ``rng``.
+
+    Every sampling site advances a slot's rng the same way — one ``jax.random.split``
+    whose row 0 becomes the carry (the sampling prefill chunk splits the request key
+    directly; decode/verify steps split the per-slot row via ``jax.vmap(split)``,
+    which is bit-identical to splitting each row alone). The carry is therefore a pure
+    split-chain of the request key and ``RequestState.rng_steps`` counts its length,
+    so this fold lets a *surviving* replica continue a migrated request's sample
+    stream bit-exact using no device state from the replica that died
+    (`ServingEngine.adopt_inflight`)."""
+    key = rng
+    for _ in range(steps):
+        key = jax.random.split(key)[0]
+    return np.asarray(key)
+
+
 @dataclass
 class _ResumeState:
     """Decode context captured at preemption: what it takes to continue the request
@@ -923,6 +940,7 @@ class ServingEngine:
         self._slot_states[slot] = state
         self._tokens[slot] = first_token
         self._rngs[slot] = np.array(carry)
+        state.rng_steps = 1  # prefill consumed one split of request.rng
         self._do_sample[slot] = do_sample
         self._temperature[slot] = temperature
         self._top_k[slot] = top_k
@@ -1493,6 +1511,7 @@ class ServingEngine:
                 self.stats.ttft_s_by_tier.setdefault(tier, []).append(state.ttft_s)
             self._tokens[slot] = first_token
             self._rngs[slot] = np.array(carry)
+            state.rng_steps = 1  # the sampling chunk consumed one split of request.rng
             if self.speculating:
                 self._spec_start(slot, prefill_ids)
             if tr is not None:
@@ -1746,6 +1765,7 @@ class ServingEngine:
                     break
             self.pool.lengths[slot] += 1 + min(len(emit), acc)
             self._tokens[slot] = emit[-1]
+            state.rng_steps += 1  # one verify step = one split of the slot's rng row
             emitted_total += len(emit)
             tr = state.trace
             if tr is not None:
@@ -1788,6 +1808,7 @@ class ServingEngine:
             self.pool.lengths[slot] += 1
             token = int(tokens[slot])
             self._tokens[slot] = token
+            state.rng_steps += 1  # this step split the slot's rng row once
             emitted += 1
             if state.trace is not None:
                 span = state.trace.open.get("decode")
@@ -1987,6 +2008,80 @@ class ServingEngine:
             # side) is closed by the disaggregation driver once the page transfer lands
             self._trace_begin_decode(state, self.scheduler.clock())
         return pages
+
+    # -------------------------------------------------- crash migration (cluster/)
+
+    def inflight_request_ids(self) -> list[int]:
+        """Request ids this engine still owes tokens to (waiting + running), sorted —
+        the router's drain-timeout diagnostics and wait() accounting."""
+        ids = {state.request.request_id for state in self.scheduler.waiting}
+        ids.update(state.request.request_id for state in self._slot_states.values())
+        return sorted(ids)
+
+    def release_inflight(self) -> list[RequestState]:
+        """Strip EVERY unfinished request out of this engine and return them in
+        (tier, FCFS seq) order for adoption elsewhere (`Router._recover_dead` /
+        `Router.drain_replica`).
+
+        Host-only bookkeeping by design: the engine may have just crashed mid-step, so
+        its device state (KV pages, per-slot rows) is assumed corrupt — nothing is read
+        from it and no prefix is registered. Each returned state is reset to a
+        slot-less ``waiting`` request; `adopt_inflight` on the destination rebuilds the
+        resume context from the host-side token log alone."""
+        released = list(self.scheduler.waiting)
+        while self.scheduler.pop_next() is not None:
+            pass
+        running = sorted(
+            self._slot_states.items(), key=lambda kv: (kv[1].tier, kv[1].seq)
+        )
+        for slot, state in running:
+            self._prefill_tasks.pop(slot, None)
+            if slot in self._prefill_order:
+                self._prefill_order.remove(slot)
+            if self.speculating:
+                self._spec_stop(slot)
+            self.pool.free(slot)
+            released.append(state)
+        self._slot_states.clear()
+        self._ready_handoffs = []
+        for state in released:
+            if self._swap is not None:
+                self._swap.drop(state.request.request_id)
+            state.slot = None
+            state.status = RequestStatus.waiting
+            state.resume = None  # rebuilt from the token log at adoption
+        released.sort(key=lambda s: (s.tier, s.seq))
+        return released
+
+    def adopt_inflight(self, state: RequestState) -> None:
+        """Admit a request released from ANOTHER replica (`release_inflight`), mid-
+        generation or not. A request that already emitted tokens re-enters through the
+        drop-and-recompute resume path: the resume context is rebuilt purely from host
+        state — next token fed is the last emitted one, the resident prefix is
+        ``(prompt + tokens)[:-1]`` (everything except that un-cache-written tail), and
+        the rng carry is re-derived by replaying ``rng_steps`` splits of the request
+        key — so chunked prefill recomputes the committed prefix (radix-cache hits
+        welcome) and decode continues token-for-token as if the crash never happened.
+        Raises QueueFullError when this engine's queue is at bound (the router's retry
+        budget spills to the next candidate)."""
+        if state.tokens and not self.paged:
+            raise ValueError("adopting a mid-generation request requires a paged engine")
+        if state.tokens:
+            state.resume = _ResumeState(
+                next_token=int(state.tokens[-1]),
+                rng=_rederive_rng_carry(state.request.rng, state.rng_steps),
+                resident=len(state.request.prompt_ids) + len(state.tokens) - 1,
+                swapped=False,
+            )
+        else:
+            state.resume = None
+        self.scheduler.adopt(state)
+
+    def swap_params(self, params) -> None:
+        """Install a new parameter pytree (rolling weight update while parked by
+        `Router.drain_replica`; the tree structure must match — compiled programs are
+        reused, so the swap costs no recompilation)."""
+        self._variables = {"params": params} if "params" not in params else params
 
     # ------------------------------------------------------------------ telemetry
 

@@ -109,6 +109,15 @@ class RequestState:
     seq: int = -1  # FCFS position within the tier, assigned once at submit
     preemptions: int = 0  # times this request was evicted mid-flight and re-enqueued
     resume: Any = None  # engine-private preemption context (swap payload / rng carry)
+    # PRNG splits consumed for this request's sample stream (1 at the sampling prefill
+    # chunk, +1 per decode/verify step it participated in). The per-slot rng carry is a
+    # pure split-chain of `request.rng`, so this count is all a surviving replica needs
+    # to re-derive the carry and continue sampling bit-exact after a crash migration
+    # (`ServingEngine.adopt_inflight`) — no device state from the dead replica required.
+    rng_steps: int = 0
+    # times this request was migrated to another replica after a crash/drain (router's
+    # reroute accounting; the `reroute` trace span carries the per-hop detail)
+    reroutes: int = 0
     # per-request distributed trace (utils/tracing.RequestTrace) when tracing is on;
     # None is the zero-cost default — every instrumentation site is one `is not None`
     # check. The state object carries the live trace across every seam (router ->
@@ -222,6 +231,19 @@ class Scheduler:
         state = RequestState(request=request, submit_t=self.clock(), seq=next(self._seq))
         self._tiers.setdefault(request.priority, deque()).append(state)
         return state
+
+    def adopt(self, state: RequestState) -> None:
+        """Enqueue a request state migrated from ANOTHER scheduler (cross-replica
+        re-routing after a crash or drain). The state keeps its original ``seq`` —
+        its FCFS age — so migrated work re-enters at roughly its arrival position
+        instead of queueing behind newer local arrivals; ``request_id`` is kept too
+        (it names the request in traces and telemetry fleet-wide). Bounded exactly
+        like `submit`: the router's retry budget handles a full destination."""
+        if self.queue_depth >= self.max_waiting:
+            raise QueueFullError(
+                f"waiting queue is full ({self.max_waiting}); retry after the pool drains"
+            )
+        self.push_front(state)
 
     def expired(self, state: RequestState) -> bool:
         deadline = state.request.deadline_s

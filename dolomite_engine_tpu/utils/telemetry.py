@@ -155,6 +155,14 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
         "prefix_affinity_hits",
         "handoff_latency_ms",
         "counters",
+        # fleet fault tolerance (serving/cluster/health.py): present only when health
+        # monitoring is on or a recovery action fired — the off path's record is
+        # byte-identical to the health-unaware router. `health` maps replica_id ->
+        # healthy|suspect|dead|parked; `reroutes`/`reroute_retries` count migrated
+        # in-flight requests and extra placement attempts beyond each one's first.
+        "health",
+        "reroutes",
+        "reroute_retries",
     ),
     # per-request distributed tracing (utils/tracing.py): one record per finished
     # request when tracing is enabled (`--trace` / trace_requests), carrying the whole
@@ -217,6 +225,14 @@ KNOWN_COUNTERS: tuple[str, ...] = (
     "router_requests_routed",
     "router_requests_rejected",
     "router_prefix_affinity_hits",
+    # fleet fault tolerance (serving/cluster/router.py + health.py): replicas declared
+    # dead (crash/wedge/thread death), in-flight requests migrated to a survivor,
+    # requests cancelled under capacity loss (lowest tier first), and completed
+    # drain_replica operations
+    "router_replica_crashes",
+    "router_requests_rerouted",
+    "router_requests_shed",
+    "router_drains",
     # prefill/decode disaggregation (serving/cluster/disagg.py): KV page transfers from
     # a prefill worker's pool into a decode worker's pool
     "cluster_kv_handoffs",
@@ -230,6 +246,14 @@ KNOWN_EVENTS: tuple[str, ...] = (
     "profile_start",
     "profiles_captured",
     "anomaly",
+    # serving-fleet fault tolerance (serving/cluster/health.py + router.py): one event
+    # per downward health edge, per completed drain/rejoin, and when a threaded
+    # Router.wait timed out with work still pending (fields name who/why)
+    "replica_suspect",
+    "replica_dead",
+    "replica_drained",
+    "replica_rejoined",
+    "router_wait_incomplete",
 )
 
 # every literal gauge name set through the registry (dynamic names — the per-device
@@ -257,6 +281,10 @@ KNOWN_GAUGES: tuple[str, ...] = (
     # replicas, and the latest prefill->decode KV handoff wall time
     "router/queue_depth",
     "cluster/handoff_latency_ms",
+    # fleet fault tolerance (serving/cluster/router.py): replicas whose health state
+    # is `healthy` (suspect/dead/parked excluded); only written when health
+    # monitoring is on
+    "router/replicas_healthy",
 )
 
 # goodput buckets, in reporting order; "other" is the window remainder (python overhead,

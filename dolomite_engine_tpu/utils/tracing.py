@@ -70,6 +70,10 @@ KNOWN_SPANS: dict[str, str] = {
     # disaggregation: first token on the prefill worker -> pages adopted on the decode
     # worker (gather/scatter transfer latency, src/dst replica)
     "handoff": "prefill->decode KV page handoff across the disaggregation seam",
+    # fleet fault tolerance (serving/cluster/router.py): replica died/drained mid-
+    # flight -> adopted by a survivor (src/dst replica, committed tokens, attempts);
+    # the adoptive replica's recompute-resume spans follow under the same root
+    "reroute": "in-flight migration to a surviving replica (crash recovery / drain)",
 }
 
 # critical-path buckets for the TTFT window, in reporting order; spans map via

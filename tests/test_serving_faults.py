@@ -1,0 +1,591 @@
+"""Serving-fleet fault tolerance (serving/cluster/health.py + faults.py + router).
+
+The chaos matrix from docs/FAULT_TOLERANCE.md "Serving fleet", driven entirely by the
+deterministic fault-injection seam: crash mid-decode and mid-prefill (sync — i.e.
+crash-during-`Router.drain` — and threaded), wedge detection by the watchdog, KV
+handoff failure on a disaggregated replica, submit rejection spill. The acceptance bar
+matches the rest of the serving suites: after a replica is killed mid-stream, every
+in-flight request finishes on a survivor TOKEN-FOR-TOKEN identical to the unfaulted
+fleet — greedy bit-exact, sampled rows too (the rng carry is re-derived, not copied) —
+with `decode_compiles == 1` on the survivor. Plus the satellite regressions: sticky
+replica-thread death (never a silent hang), `drain(timeout_s=)` naming stuck work,
+drain -> swap_params -> rejoin with zero drops and session affinity following, and the
+byte-identical off path (no monitor, no injector => pre-fault-tolerance records).
+"""
+
+import json
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import (
+    DisaggregatedEngine,
+    DrainTimeoutError,
+    EngineReplica,
+    Fault,
+    FaultInjector,
+    NoLiveReplicasError,
+    QueueFullError,
+    ReplicaHealth,
+    ReplicaHealthMonitor,
+    RequestStatus,
+    Router,
+    SamplingParams,
+    ServingEngine,
+    serve_batch,
+)
+from dolomite_engine_tpu.serving.engine import _rederive_rng_carry
+
+from .test_commons import get_dense_test_config
+
+PAGE = 16
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+def _engine_kwargs(config, **overrides):
+    kwargs = dict(
+        num_slots=2,
+        max_len=96,
+        prefill_bucket_multiple=8,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+        page_size=PAGE,
+        prefill_chunk_tokens=16,  # long prompts span >= 2 chunks: mid-prefill crashes exist
+    )
+    kwargs.update(overrides)
+    return kwargs
+
+
+# One model + one unfaulted reference run shared by the whole matrix, so parametrized
+# fault scenarios don't pay the model/compile cost repeatedly.
+_STATE: dict = {}
+
+
+def _model():
+    if "model" not in _STATE:
+        _STATE["model"] = _tiny_model()
+    return _STATE["model"]
+
+
+def _fleet_workload(config):
+    """Four in-flight requests: three greedy (bit-exact bar) and one SAMPLED row —
+    migrating it proves the rng carry re-derivation, the hardest parity case."""
+    rs = np.random.RandomState(1234)
+    prompts = [
+        _random_prompt(rs, config, 20),
+        _random_prompt(rs, config, 17),
+        _random_prompt(rs, config, 23),
+        _random_prompt(rs, config, 9),
+    ]
+    specs = [dict(prompt_ids=p, max_new_tokens=8) for p in prompts]
+    # row 2 lands on replica 0 (the one the matrix kills) under least-loaded placement
+    specs[2]["sampling"] = SamplingParams(do_sample=True, temperature=0.9)
+    specs[2]["rng"] = jax.random.PRNGKey(42)
+    return specs
+
+
+def _fleet_expected():
+    """Tokens from an unfaulted single engine on the shared workload (memoized)."""
+    if "fleet_expected" not in _STATE:
+        config, model, params = _model()
+        engine = ServingEngine(model, params, **_engine_kwargs(config))
+        specs = [dict(s) for s in _fleet_workload(config)]
+        _STATE["fleet_expected"] = [s.tokens for s in serve_batch(engine, specs)]
+    return _STATE["fleet_expected"]
+
+
+def _lenient_monitor(**overrides):
+    """A monitor whose wedge thresholds sit far above CPU compile time: a first step
+    that traces+compiles for seconds must not read as a wedge in non-wedge tests."""
+    kwargs = dict(max_consecutive_exceptions=2, suspect_after_s=30.0, dead_after_s=60.0)
+    kwargs.update(overrides)
+    return ReplicaHealthMonitor(**kwargs)
+
+
+def _two_replicas(injector=None):
+    config, model, params = _model()
+    replicas = [
+        EngineReplica(i, ServingEngine(model, params, **_engine_kwargs(config)),
+                      fault_injector=injector)
+        for i in range(2)
+    ]
+    return config, replicas
+
+
+def _submit_workload(router, config):
+    done = []
+    states = [
+        router.submit(**spec, on_finish=done.append)
+        for spec in _fleet_workload(config)
+    ]
+    return states, done
+
+
+class _StubEngine:
+    """Minimal engine surface for router-plumbing tests — no jax, no model, so the
+    timeout/thread-death contracts are asserted in milliseconds."""
+
+    def __init__(self, *, step_error=None, busy_ids=()):
+        self.busy_ids = list(busy_ids)
+        self.step_error = step_error
+        self.scheduler = SimpleNamespace(queue_depth=0)
+        self.pool = SimpleNamespace(occupancy=0.0, num_active=len(self.busy_ids), page_size=0)
+        self.steps = 0
+
+    def prefix_match_len(self, prompt_ids):
+        return 0
+
+    def has_work(self):
+        return bool(self.busy_ids) or self.step_error is not None
+
+    def step(self):
+        self.steps += 1
+        if self.step_error is not None:
+            raise self.step_error
+        return bool(self.busy_ids)
+
+    def inflight_request_ids(self):
+        return sorted(self.busy_ids)
+
+    def release_inflight(self):
+        self.busy_ids = []
+        return []
+
+    def emit_serving_record(self):
+        pass
+
+
+# ------------------------------------------------------------------- the primitives
+
+
+def test_rng_carry_rederivation_matches_vmap_split():
+    """The migration primitive's rng re-derivation: the engine advances each slot's
+    rng one `split` per sampling step carrying row 0, and `vmap(split)` row 0 is
+    bit-identical to the sequential fold — so `_rederive_rng_carry(request.rng,
+    rng_steps)` reproduces the exact carry a dead replica held, from host state only."""
+    key = jax.random.PRNGKey(42)
+    carried = jnp.asarray([key])  # one occupied slot, advanced like the decode batch
+    for _ in range(5):
+        carried = jax.vmap(jax.random.split)(carried)[:, 0]
+    np.testing.assert_array_equal(np.asarray(carried[0]), _rederive_rng_carry(key, 5))
+
+
+def test_fault_injector_seeded_deterministic():
+    """The chaos matrix is a loop over seeds: the same seed must always yield the same
+    plan, and every generated fault must be well-formed."""
+    mk = lambda: FaultInjector.seeded(  # noqa: E731
+        7, [0, 1], kinds=("crash", "wedge"), count=3, step_range=(2, 10), wedge_s=0.3
+    )
+    a, b = mk(), mk()
+    assert a.faults == b.faults
+    for fault in a.faults:
+        assert fault.kind in ("crash", "wedge")
+        assert fault.replica_id in (0, 1)
+        assert 2 <= fault.at < 10
+    assert FaultInjector.seeded(8, [0, 1], kinds=("crash", "wedge"), count=3).faults != a.faults
+
+
+def test_fault_validation():
+    with pytest.raises(ValueError):
+        Fault(kind="meteor", replica_id=0)
+    with pytest.raises(ValueError):
+        Fault(kind="wedge", replica_id=0)  # wedge_s required
+    injector = FaultInjector([Fault(kind="reject", replica_id=0, at=0)])
+    with pytest.raises(QueueFullError):
+        injector.on_submit(0)
+    injector.on_submit(0)  # reject is one-shot: the retry goes through
+    assert [f.site for f in injector.fired] == ["submit"]
+
+
+def test_health_ladder_and_watchdog():
+    now = [0.0]
+    monitor = ReplicaHealthMonitor(
+        max_consecutive_exceptions=2, suspect_after_s=1.0, dead_after_s=5.0,
+        clock=lambda: now[0],
+    )
+    monitor.register(0)
+    assert monitor.state(0) is ReplicaHealth.healthy
+    monitor.begin_step(0)
+    monitor.end_step(0, error=RuntimeError("flake"))
+    assert monitor.state(0) is ReplicaHealth.suspect
+    assert monitor.is_routable(0)  # suspect is a warning, not a verdict
+    monitor.begin_step(0)
+    monitor.end_step(0)  # success resets the ladder
+    assert monitor.state(0) is ReplicaHealth.healthy
+    for _ in range(2):
+        monitor.begin_step(0)
+        monitor.end_step(0, error=RuntimeError("crash"))
+    assert monitor.state(0) is ReplicaHealth.dead
+    assert not monitor.is_routable(0)
+    assert monitor.sweep() == [0]
+    assert monitor.sweep() == []  # dead reported exactly once
+    monitor.reset(0)
+    # wedge watchdog: an in-progress step older than dead_after_s
+    monitor.begin_step(0)
+    now[0] += 6.0
+    assert monitor.sweep() == [0]
+    assert monitor.state(0) is ReplicaHealth.dead
+
+
+# --------------------------------------------------------------------- chaos matrix
+
+
+@pytest.mark.parametrize("mode", ["sync", "threaded"])
+def test_crash_mid_decode_migrates_bit_exact(mode):
+    """Kill replica 0 mid-decode (work step 6, committed tokens on both its slots).
+    Sync mode crashes INSIDE `Router.drain` — the crash-during-drain cell. Every
+    in-flight request (including the sampled row) must finish on the survivor
+    token-for-token identical to the unfaulted fleet, with one compiled decode step."""
+    expected = _fleet_expected()
+    injector = FaultInjector([Fault(kind="crash", replica_id=0, at=6)])
+    config, replicas = _two_replicas(injector)
+    router = Router(
+        replicas,
+        health=_lenient_monitor(),
+    )
+    states, done = _submit_workload(router, config)
+    if mode == "sync":
+        router.drain(timeout_s=120.0)
+    else:
+        router.start()
+        assert router.wait(timeout_s=120.0)
+        router.stop()
+
+    assert [s.tokens for s in states] == expected  # sampled row too: rng re-derived
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert len(done) == len(states)  # completion accounting: every on_finish delivered
+    assert router.stats.replica_crashes == 1
+    assert router.stats.rerouted == 2  # both of replica 0's slots moved
+    assert router.stats.shed == 0
+    assert any(s.reroutes == 1 for s in states)
+    assert router.health.state(0) is ReplicaHealth.dead
+    assert replicas[1].engine.decode_compiles == 1  # migration is recompute, not recompile
+    assert [f.site for f in injector.fired] == ["step"]
+
+
+def test_crash_mid_prefill_restarts_cleanly():
+    """Crash during chunked prefill (work step 1, no committed tokens yet): the orphan
+    replays from scratch on the survivor — same tokens as an unfaulted run."""
+    config, model, params = _model()
+    rs = np.random.RandomState(77)
+    long_prompt = _random_prompt(rs, config, 40)  # 3 prefill chunks of 16
+    short_prompt = _random_prompt(rs, config, 7)
+    specs = [
+        dict(prompt_ids=long_prompt, max_new_tokens=6),
+        dict(prompt_ids=short_prompt, max_new_tokens=6),
+    ]
+    reference = ServingEngine(model, params, **_engine_kwargs(config))
+    expected = [s.tokens for s in serve_batch(reference, [dict(s) for s in specs])]
+
+    injector = FaultInjector([Fault(kind="crash", replica_id=0, at=1)])
+    _, replicas = _two_replicas(injector)
+    router = Router(replicas, health=_lenient_monitor())
+    states = [router.submit(**spec) for spec in specs]
+    router.drain(timeout_s=120.0)
+
+    assert [s.tokens for s in states] == expected
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert router.stats.replica_crashes == 1
+    assert router.stats.rerouted == 1
+    assert states[0].reroutes == 1 and states[0].tokens == expected[0]
+
+
+@pytest.mark.parametrize("mode", ["sync", "threaded"])
+def test_wedge_detected_and_migrated(mode):
+    """A wedged step (hung device call) must not hang the fleet. Threaded: the
+    watchdog sweep declares the replica dead while its thread is still asleep and
+    migrates around it. Sync: nothing can sweep mid-step, so the completed-late path
+    in `end_step` is what must fire. Either way: token parity on the survivors."""
+    expected = _fleet_expected()
+    injector = FaultInjector([Fault(kind="wedge", replica_id=0, at=3, wedge_s=1.2)])
+    config, replicas = _two_replicas(injector)
+    # warm both engines' compile caches (same prompt-length buckets as the workload)
+    # BEFORE arming the tight watchdog: on CPU a compiling first step takes seconds,
+    # which a 0.4s wedge threshold would misread as a wedge on every replica
+    rs = np.random.RandomState(999)
+    for replica in replicas:
+        serve_batch(
+            replica.engine,
+            [
+                dict(prompt_ids=_random_prompt(rs, config, n), max_new_tokens=2)
+                for n in (20, 17, 23, 9)
+            ],
+        )
+    router = Router(
+        replicas,
+        health=ReplicaHealthMonitor(
+            max_consecutive_exceptions=1, suspect_after_s=0.2, dead_after_s=0.4
+        ),
+    )
+    states, done = _submit_workload(router, config)
+    if mode == "sync":
+        router.drain(timeout_s=120.0)
+    else:
+        router.start()
+        assert router.wait(timeout_s=120.0)
+        router.stop()
+
+    assert [s.tokens for s in states] == expected
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert len(done) == len(states)
+    assert router.stats.replica_crashes == 1
+    assert router.stats.shed == 0
+    assert router.health.state(0) is ReplicaHealth.dead
+    assert [f.fault.kind for f in injector.fired] == ["wedge"]
+
+
+def test_handoff_failure_migrates():
+    """A planned KV-transfer failure on a disaggregated replica: the mid-handoff
+    request (resident in BOTH the prefill worker and a decode worker at the instant of
+    failure) migrates exactly once — no duplicate — and finishes bit-exact elsewhere.
+
+    `max_consecutive_exceptions=1` is load-bearing: a failed handoff leaves
+    half-adopted state behind, so the replica must not be retried in place (the
+    threshold only tolerates faults that fire between engine mutations)."""
+    config, model, params = _model()
+    workload = _fleet_workload(config)
+    greedy = [0, 1, 3]  # the greedy rows of the shared workload
+    specs = [dict(workload[i]) for i in greedy]
+    expected = [_fleet_expected()[i] for i in greedy]
+
+    injector = FaultInjector([Fault(kind="handoff", replica_id=0, at=0)])
+    prefill = ServingEngine(model, params, **_engine_kwargs(config, prefill_only=True))
+    worker = ServingEngine(model, params, **_engine_kwargs(config))
+    disagg = DisaggregatedEngine(prefill, [worker])
+    replicas = [
+        EngineReplica(0, disagg, fault_injector=injector),
+        EngineReplica(1, ServingEngine(model, params, **_engine_kwargs(config))),
+    ]
+    assert disagg.handoff.fault_injector is injector  # the replica wired the seam
+    router = Router(replicas, health=_lenient_monitor(max_consecutive_exceptions=1))
+    done = []
+    states = [router.submit(**spec, on_finish=done.append) for spec in specs]
+    router.drain(timeout_s=120.0)
+
+    assert [s.tokens for s in states] == expected
+    assert all(s.status == RequestStatus.completed for s in states)
+    assert router.stats.replica_crashes == 1
+    assert router.stats.shed == 0
+    assert router.health.state(0) is ReplicaHealth.dead
+    assert [f.site for f in injector.fired] == ["transfer"]
+    # the mid-handoff request was resident on BOTH sides of the seam when it failed;
+    # the release dedup means it still finishes exactly once
+    assert len(done) == len(states)
+
+
+def test_reject_fault_spills_to_other_replica():
+    """A replica refusing a submit (planned rejection) must spill to the next
+    candidate, not bubble QueueFullError to the caller."""
+    config, model, params = _model()
+    spec = dict(_fleet_workload(config)[3])
+    injector = FaultInjector([Fault(kind="reject", replica_id=0, at=0)])
+    _, replicas = _two_replicas(injector)
+    router = Router(replicas)
+    state = router.submit(**spec)
+    router.drain(timeout_s=120.0)
+    assert state.status == RequestStatus.completed
+    assert state.tokens == _fleet_expected()[3]
+    assert router.stats.per_replica_routed == {1: 1}  # spilled off the rejecting replica
+    assert router.stats.rejected == 0
+    assert [f.site for f in injector.fired] == ["submit"]
+
+
+# --------------------------------------------------------- satellite regressions
+
+
+def test_replica_thread_death_is_sticky():
+    """Regression: a replica worker thread that dies must NOT leave the fleet hanging
+    silently — the failure is captured sticky, `Router.wait` re-raises it, and so does
+    every later `step()` on that replica."""
+    boom = RuntimeError("boom: planted thread death")
+    replicas = [EngineReplica(0, _StubEngine(step_error=boom))]
+    router = Router(replicas)
+    router.start()
+    with pytest.raises(RuntimeError, match="planted thread death"):
+        router.wait(timeout_s=10.0)
+    router.stop()
+    assert replicas[0].error is boom
+    with pytest.raises(RuntimeError, match="planted thread death"):
+        replicas[0].step()  # sticky: the dead replica fails loudly forever
+
+
+def test_replica_thread_death_reported_to_monitor():
+    """With a health monitor the same thread death is reported via `mark_dead` and the
+    router recovers (quarantine + migration) instead of re-raising."""
+    boom = RuntimeError("boom")
+    replicas = [
+        EngineReplica(0, _StubEngine(step_error=boom)),
+        EngineReplica(1, _StubEngine()),
+    ]
+    router = Router(replicas, health=ReplicaHealthMonitor())
+    router.start()
+    assert router.wait(timeout_s=10.0)  # recovery, not a hang and not a raise
+    router.stop()
+    assert router.stats.replica_crashes == 1
+    assert router.health.state(0) is ReplicaHealth.dead
+    assert router.select([1, 2, 3])[0] is replicas[1]  # dead replica never routes
+
+
+def test_no_live_replicas_error():
+    """A fleet whose only replica died rejects routing with NoLiveReplicasError —
+    distinct from QueueFullError (alive but full: retry later)."""
+    replicas = [EngineReplica(0, _StubEngine(step_error=RuntimeError("boom")))]
+    router = Router(replicas, health=ReplicaHealthMonitor(max_consecutive_exceptions=1))
+    router.step()  # the failed step walks the ladder; the sweep quarantines
+    router.step()
+    with pytest.raises(NoLiveReplicasError):
+        router.select([1, 2, 3])
+
+
+def test_drain_timeout_names_stuck_replica():
+    """Regression: `Router.drain` used to spin forever on a replica that always
+    reports work. With `timeout_s=` it raises, naming the stuck replica and its
+    in-flight request ids — actionable, not a hang."""
+    replicas = [EngineReplica(0, _StubEngine(busy_ids=[7, 12]))]
+    router = Router(replicas)
+    with pytest.raises(DrainTimeoutError, match=r"0.*\[7, 12\]"):
+        router.drain(timeout_s=0.05)
+
+
+def test_wait_timeout_emits_router_event(tmp_path):
+    """`Router.wait` returning False must say WHO still has work: it emits a
+    ``router_wait_incomplete`` telemetry event with the pending request ids."""
+    from dolomite_engine_tpu.utils.telemetry import (
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    sink = tmp_path / "wait.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        router = Router([EngineReplica(0, _StubEngine(busy_ids=[3]))])
+        assert router.wait(timeout_s=0.05) is False
+        telemetry.close()
+    finally:
+        uninstall_telemetry()
+    events = [
+        json.loads(line)
+        for line in open(sink)
+        if json.loads(line).get("event") == "router_wait_incomplete"
+    ]
+    assert len(events) == 1
+    assert events[0]["pending"] == {"0": [3]}
+
+
+def test_drain_swap_rejoin_roundtrip(tmp_path):
+    """The rolling-update primitive: drain a replica mid-stream (its in-flight session
+    request migrates, zero drops), swap its params while parked, rejoin it — the
+    session's next turn follows the migration, and the drained replica takes fresh
+    traffic again afterwards. Token parity holds across the whole dance."""
+    from dolomite_engine_tpu.utils.telemetry import (
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _model()
+    workload = _fleet_workload(config)
+    expected = _fleet_expected()
+
+    sink = tmp_path / "roundtrip.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        _, replicas = _two_replicas()
+        router = Router(replicas, health=_lenient_monitor())
+        spec = dict(workload[0])
+        spec["session_id"] = "sess-roundtrip"
+        state = router.submit(**spec)  # lands on replica 0 (least-loaded tie-break)
+        assert router.stats.per_replica_routed == {0: 1}
+        for _ in range(4):
+            router.step()  # commit some tokens so the drain migrates MID-decode
+        router.drain_replica(0)
+        assert router.stats.drains == 1
+        assert state.reroutes == 1  # migrated, not dropped
+        replicas[0].swap_params(jax.tree_util.tree_map(jnp.asarray, params))
+        router.rejoin_replica(0)
+        router.drain(timeout_s=120.0)
+        assert state.status == RequestStatus.completed
+        assert state.tokens == expected[0]  # bit-exact across the migration
+        assert router.stats.shed == 0
+
+        # next turn of the session: affinity follows the migration to replica 1
+        turn2 = dict(prompt_ids=spec["prompt_ids"] + state.tokens, max_new_tokens=4,
+                     session_id="sess-roundtrip")
+        router.submit(**turn2)
+        assert router.stats.per_replica_routed.get(1, 0) == 1
+        assert router.stats.session_affinity_hits == 1
+        # a fresh sessionless prompt: the rejoined (idle) replica takes traffic again
+        router.submit(**dict(workload[3]))
+        assert router.stats.per_replica_routed[0] == 2
+        router.drain(timeout_s=120.0)
+        telemetry.close()
+    finally:
+        uninstall_telemetry()
+    events = [json.loads(line) for line in open(sink)]
+    assert [e["event"] for e in events if e.get("kind") == "event" and e["event"].startswith("replica_")] == [
+        "replica_drained",
+        "replica_rejoined",
+    ]
+
+
+def test_off_path_is_byte_identical(tmp_path):
+    """No monitor, no injector: the fault-tolerance seams must cost nothing — the
+    router record carries EXACTLY the pre-fault-tolerance field set (no health block),
+    no fleet counters materialize, and compile counts are unchanged."""
+    from dolomite_engine_tpu.utils.telemetry import (
+        Telemetry,
+        install_telemetry,
+        uninstall_telemetry,
+    )
+
+    config, model, params = _model()
+    workload = _fleet_workload(config)
+    sink = tmp_path / "offpath.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        _, replicas = _two_replicas()
+        router = Router(replicas)
+        states = [router.submit(**dict(workload[i])) for i in (0, 3)]
+        router.drain(timeout_s=120.0)
+        telemetry.close()
+        assert [s.tokens for s in states] == [_fleet_expected()[0], _fleet_expected()[3]]
+        assert all(r.engine.decode_compiles == 1 for r in replicas)
+        for name in (
+            "router_replica_crashes",
+            "router_requests_rerouted",
+            "router_requests_shed",
+            "router_drains",
+        ):
+            assert name not in telemetry.counters  # the off path never touches them
+        assert "router/replicas_healthy" not in telemetry.gauges
+    finally:
+        uninstall_telemetry()
+    records = [json.loads(line) for line in open(sink)]
+    router_record = [r for r in records if r["kind"] == "router"][-1]
+    assert set(router_record) == {
+        "kind", "ts", "rank",
+        "replicas", "queue_depths", "slots_active", "routed", "rejected",
+        "prefix_affinity_hits", "handoff_latency_ms", "counters",
+    }
+    assert set(router_record["counters"]) == {
+        "per_replica_routed", "prefix_affinity_hit_rate", "session_affinity_hits",
+        "sessions_tracked", "kv_handoffs",
+    }

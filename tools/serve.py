@@ -145,6 +145,15 @@ def parse_args() -> argparse.Namespace:
         "explicit KV page handoff (serving/cluster/disagg.py)",
     )
     p.add_argument(
+        "--health-monitoring",
+        action="store_true",
+        help="fleet fault tolerance (docs/FAULT_TOLERANCE.md 'Serving fleet'): "
+        "heartbeat every replica step, declare crashed/wedged replicas dead "
+        "(healthy->suspect->dead with telemetry events), and migrate their in-flight "
+        "requests to surviving replicas bit-exact. Implies the router path even with "
+        "--replicas 1; zero overhead when off",
+    )
+    p.add_argument(
         "--trace",
         action="store_true",
         help="per-request distributed tracing (docs/OBSERVABILITY.md): every request "
@@ -279,10 +288,11 @@ def main() -> None:
         return ServingEngine(model.model, params, **kwargs)
 
     router = None
-    if args.replicas > 1 or args.disaggregate:
+    if args.replicas > 1 or args.disaggregate or args.health_monitoring:
         from dolomite_engine_tpu.serving.cluster import (
             DisaggregatedEngine,
             EngineReplica,
+            ReplicaHealthMonitor,
             Router,
         )
 
@@ -303,7 +313,12 @@ def main() -> None:
             else:
                 replica_engine = build_engine()
             replicas.append(EngineReplica(replica_id, replica_engine))
-        router = Router(replicas, record_interval=100, trace_requests=args.trace)
+        router = Router(
+            replicas,
+            record_interval=100,
+            trace_requests=args.trace,
+            health=ReplicaHealthMonitor() if args.health_monitoring else None,
+        )
     else:
         engine = build_engine()
 
@@ -385,6 +400,17 @@ def main() -> None:
             f"{handoff_info}; {completed} completed, {cancelled} cancelled",
             file=sys.stderr,
         )
+        if router.health is not None:
+            rstats = router.stats
+            healthy = sum(
+                1 for s in router.health.states().values() if str(s) == "healthy"
+            )
+            print(
+                f"fleet: {healthy}/{len(router.replicas)} replicas healthy, "
+                f"{rstats.replica_crashes} crashed, {rstats.rerouted} requests "
+                f"rerouted, {rstats.shed} shed, {rstats.drains} drains",
+                file=sys.stderr,
+            )
         return
 
     stats = engine.stats

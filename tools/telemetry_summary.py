@@ -409,6 +409,31 @@ def summarize(records: list[dict]) -> str:
                 f"(mean {last['handoff_latency_ms']:.1f}ms)"
             )
         lines.append(", ".join(parts))
+        # fleet fault tolerance: the record carries health/reroute fields only when
+        # health monitoring was on or a recovery action fired (serving/cluster/)
+        health = last.get("health")
+        if health is not None:
+            healthy = sum(1 for s in health.values() if s == "healthy")
+            fleet = [
+                f"fleet: {healthy}/{len(health)} replicas healthy "
+                + "("
+                + ", ".join(f"#{k}:{v}" for k, v in sorted(health.items()))
+                + ")"
+            ]
+            crashes = counters.get("replica_crashes", 0)
+            if crashes:
+                fleet.append(f"{crashes} crashed")
+            reroutes = last.get("reroutes", 0)
+            if reroutes:
+                fleet.append(
+                    f"{reroutes} requests rerouted "
+                    f"({last.get('reroute_retries', 0)} extra attempts)"
+                )
+            if counters.get("requests_shed"):
+                fleet.append(f"{counters['requests_shed']} shed")
+            if counters.get("drains"):
+                fleet.append(f"{counters['drains']} drains")
+            lines.append(", ".join(fleet))
         lines.append("")
 
     # ---------------------------------------------------------------- traces

@@ -1,70 +1,145 @@
 #!/bin/bash
-# Pending on-chip measurements (round 4). Waits up to ~6.6h for the tunneled TPU to come
-# back, then runs every queued measurement sequentially. Order matters: OOM-risky runs
-# LAST — an OOM'd remote compile can wedge the lease for every following run.
+# Pending on-chip measurements (round 6), restructured as a resumable queue after the
+# r03-r05 zero-data outcomes: one long-lived claim of the tunneled TPU used to run the
+# whole ~13h batch blind, so a mid-batch backend outage burned every remaining timeout
+# and emitted nothing.
 #
-# Run in background, tee the output:  bash tools/tpu_measurement_queue.sh 2>&1 | tee /tmp/queue_r4.log
+# Queue discipline:
+#   * SHORT CLAIM WINDOWS — the TPU is re-probed before EVERY measurement; one claim
+#     covers one measurement, so a chip that dies mid-batch only loses the run in
+#     flight, not the rest of the queue.
+#   * PARTIAL-WINDOW EMISSION — each measurement's result line is appended to
+#     $RESULTS the moment it finishes; whatever the chip managed before an outage is
+#     on disk, parseable, and attributed.
+#   * QUEUED RETRIES ACROSS ATTEMPTS — a measurement that times out or produces no
+#     output is requeued (up to $MAX_TRIES attempts) and the loop goes back to
+#     probing; completed names land in $STATE so re-running this script (a new
+#     attempt, after a lease loss, tomorrow) skips what already succeeded.
+#   * Order still matters: OOM-risky runs stay LAST — an OOM'd remote compile can
+#     wedge the lease for every following run, but now it can only wedge the tail.
+#
+# Run in background, tee the output:
+#   bash tools/tpu_measurement_queue.sh 2>&1 | tee /tmp/queue_r6.log
 cd /root/repo
 
+STATE=${DOLOMITE_QUEUE_STATE:-/tmp/tpu_queue_r6.done}
+RESULTS=${DOLOMITE_QUEUE_RESULTS:-/tmp/tpu_queue_r6.results}
+MAX_TRIES=${DOLOMITE_QUEUE_MAX_TRIES:-3}
+# ~6.6h of probe patience total (observed backend outages have run 10h+; probes spent
+# waiting do not count against any measurement's tries)
+MAX_PROBES=${DOLOMITE_QUEUE_MAX_PROBES:-200}
+PROBE_SLEEP=120
+
 SW="timeout 900 python tools/bench_sweep.py"
+touch "$STATE" "$RESULTS"
+probes_left=$MAX_PROBES
 
-# 400 probes x ~2min ~= 13h of patience: observed backend outages have run 10h+
-for i in $(seq 1 400); do
-  if timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK; then
-    echo "=== TPU recovered at $(date)"
+probe_tpu() {
+  # one short claim: a trivial jit on a live TPU backend, bounded at 90s
+  timeout 90 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: x*2)(jnp.ones(4)); assert jax.default_backend() == 'tpu', jax.default_backend(); print('TPU_OK')" 2>/dev/null | grep -q TPU_OK
+}
 
-    echo "=== bench.py driver config (splash default, median-of-3 windows)"
-    # retries off: this loop already waited for a live chip; deadline keeps one parseable
-    # line inside the outer timeout even if the one-shot kernel fallback triggers
-    DOLOMITE_BENCH_RETRIES=0 DOLOMITE_BENCH_DEADLINE=1100 timeout 1200 python bench.py 2>&1 | tail -1
+wait_for_tpu() {
+  while (( probes_left > 0 )); do
+    if probe_tpu; then return 0; fi
+    probes_left=$((probes_left - 1))
+    sleep "$PROBE_SLEEP"
+  done
+  return 1
+}
 
-    echo "=== A/B: splash+packed accum16"
-    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --packed --windows 3 --steps 5 2>&1 | tail -1
-    echo "=== A/B: splash accum32"
-    timeout 1200 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 32 --fused_loss --splash --windows 3 --steps 3 2>&1 | tail -1
-    echo "=== A/B: latency-hiding scheduler (splash accum16)"
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5 2>&1 | tail -1
-    echo "=== A/B: loss_chunk 512 (splash accum16)"
-    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --loss_chunk 512 --splash --windows 3 --steps 5 2>&1 | tail -1
-    echo "=== A/B: head_dim 128 (1024x24 n_head 8 kv 4, splash accum16)"
-    $SW --n_embd 1024 --n_layer 24 --n_head 8 --kv_heads 4 --micro_bs 8 --accum 16 --fused_loss --splash --windows 3 --steps 5 2>&1 | tail -1
+FAILURES=0
 
-    echo "=== Granite-3B shape, head_dim 80: 2560x6 n_head 32 kv 8, n_inner 10240, mu_bf16"
-    $SW --n_embd 2560 --n_layer 6 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 5 2>&1 | tail -1
-    echo "=== Granite-3B shape, head_dim 128: 2560x6 n_head 20 kv 10, n_inner 10240, mu_bf16"
-    $SW --n_embd 2560 --n_layer 6 --n_head 20 --kv_heads 10 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 5 2>&1 | tail -1
-
-    echo "=== family: MoE 8x top2 (ragged_dot scatter, splash)"
-    $SW --n_embd 1024 --n_layer 12 --micro_bs 8 --accum 8 --fused_loss --splash --moe 8 --top_k 2 --windows 3 --steps 5 2>&1 | tail -1
-    echo "=== family: DenseMoE 8 experts (wide soft-routed MLP)"
-    $SW --model_type dense_moe --moe 8 --n_embd 1024 --n_layer 8 --n_head 16 --micro_bs 4 --accum 8 --fused_loss --windows 3 --steps 5 2>&1 | tail -1
-    echo "=== family: RNNDolomite (ddda hybrid, chunked delta rule)"
-    $SW --model_type rnn_dolomite --n_embd 1024 --n_layer 24 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --windows 3 --steps 5 2>&1 | tail -1
-    echo "=== family: GPTCrossLayer (kv_sharing 2, splash)"
-    $SW --model_type gpt_crosslayer --n_embd 1024 --n_layer 24 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --windows 3 --steps 5 2>&1 | tail -1
-
-    echo "=== long context seq 8192 (splash, ckpt 1)"
-    $SW --n_embd 1024 --n_layer 24 --micro_bs 2 --accum 8 --seq 8192 --fused_loss --splash --ckpt 1 --windows 2 --steps 3 2>&1 | tail -1
-    echo "=== generation bench (host-fetch timing)"
-    timeout 900 python tools/bench_generation.py 2>&1 | tail -1
-
-    echo "=== bf16 control mb4 accum8 (for the fp8 delta)"
-    $SW --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --windows 3 --steps 5 2>&1 | tail -1
-    echo "=== fp8 mb4 accum8 (OOM risk from here down)"
-    $SW --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --dtype fp8 --windows 2 --steps 5 2>&1 | tail -3
-    echo "=== cpu_offload: Granite shape 2560x8 WITH offload (should fit)"
-    $SW --n_embd 2560 --n_layer 8 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --offload --windows 2 --steps 3 2>&1 | tail -1
-    echo "=== control: Granite shape 2560x8 WITHOUT offload (may OOM — proves offload's value)"
-    $SW --n_embd 2560 --n_layer 8 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 3 2>&1 | tail -1
-    echo "=== chip-filling: 1536x16 n_head 12 kv 6 splash mu_bf16 accum8"
-    $SW --n_embd 1536 --n_layer 16 --n_head 12 --kv_heads 6 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --windows 2 --steps 5 2>&1 | tail -1
-    echo "=== chip-filling: 2048x12 n_head 16 kv 8 splash mu_bf16 ckpt1+dots accum8"
-    $SW --n_embd 2048 --n_layer 12 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --ckpt_policy dots_saveable --windows 2 --steps 5 2>&1 | tail -1
-
-    echo "=== done at $(date)"
-    exit 0
+# measure NAME CMD... — probe, run, emit the result line immediately, record state.
+# A measurement that produces nothing is requeued (tries bookkeeping in $STATE) and
+# counted in FAILURES; the queue keeps going — later measurements still get their
+# claim windows — and a later pass re-attempts it.
+measure() {
+  local name=$1; shift
+  if grep -qxF "$name" "$STATE"; then
+    echo "=== skip (done in a previous attempt): $name"
+    return 0
   fi
-  sleep 120
+  local tries
+  tries=$(grep -cxF "try:$name" "$STATE" || true)
+  if (( tries >= MAX_TRIES )); then
+    echo "=== giving up after $MAX_TRIES tries: $name"
+    return 0
+  fi
+  if ! wait_for_tpu; then
+    echo "=== TPU never recovered (while queued for: $name)"
+    exit 1
+  fi
+  echo "try:$name" >> "$STATE"
+  echo "=== $name (attempt $((tries + 1))/$MAX_TRIES) at $(date)"
+  local out
+  out=$("$@" 2>&1 | tail -1)
+  if [[ -n "$out" ]]; then
+    echo "$out"
+    printf '%s\t%s\n' "$name" "$out" >> "$RESULTS"   # partial-window emission
+    echo "$name" >> "$STATE"
+  else
+    echo "=== no output (requeued): $name"
+    FAILURES=$((FAILURES + 1))
+  fi
+}
+
+run_queue() {
+  # retries off inside bench.py: the probe already vouched for a live chip; deadline
+  # keeps one parseable line inside the outer timeout even on kernel fallback
+  measure "bench_driver_splash_default" \
+    env DOLOMITE_BENCH_RETRIES=0 DOLOMITE_BENCH_DEADLINE=1100 timeout 1200 python bench.py
+  measure "ab_splash_packed_accum16" \
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --packed --windows 3 --steps 5
+  measure "ab_splash_accum32" \
+    timeout 1200 python tools/bench_sweep.py --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 32 --fused_loss --splash --windows 3 --steps 3
+  measure "ab_latency_hiding_scheduler" \
+    env XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true" $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --splash --steps 5
+  measure "ab_loss_chunk_512" \
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 8 --accum 16 --fused_loss --loss_chunk 512 --splash --windows 3 --steps 5
+  measure "ab_head_dim_128" \
+    $SW --n_embd 1024 --n_layer 24 --n_head 8 --kv_heads 4 --micro_bs 8 --accum 16 --fused_loss --splash --windows 3 --steps 5
+
+  measure "granite3b_head_dim_80" \
+    $SW --n_embd 2560 --n_layer 6 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 5
+  measure "granite3b_head_dim_128" \
+    $SW --n_embd 2560 --n_layer 6 --n_head 20 --kv_heads 10 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 5
+
+  measure "family_moe_8x_top2" \
+    $SW --n_embd 1024 --n_layer 12 --micro_bs 8 --accum 8 --fused_loss --splash --moe 8 --top_k 2 --windows 3 --steps 5
+  measure "family_dense_moe_8" \
+    $SW --model_type dense_moe --moe 8 --n_embd 1024 --n_layer 8 --n_head 16 --micro_bs 4 --accum 8 --fused_loss --windows 3 --steps 5
+  measure "family_rnn_dolomite" \
+    $SW --model_type rnn_dolomite --n_embd 1024 --n_layer 24 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --windows 3 --steps 5
+  measure "family_gpt_crosslayer" \
+    $SW --model_type gpt_crosslayer --n_embd 1024 --n_layer 24 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --windows 3 --steps 5
+
+  measure "long_context_seq8192" \
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 2 --accum 8 --seq 8192 --fused_loss --splash --ckpt 1 --windows 2 --steps 3
+  measure "generation_bench" \
+    timeout 900 python tools/bench_generation.py
+
+  measure "bf16_control_mb4_accum8" \
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --windows 3 --steps 5
+  # OOM risk from here down — kept last so a wedged lease costs only the tail
+  measure "fp8_mb4_accum8" \
+    $SW --n_embd 1024 --n_layer 24 --micro_bs 4 --accum 8 --fused_loss --dtype fp8 --windows 2 --steps 5
+  measure "offload_granite_2560x8" \
+    $SW --n_embd 2560 --n_layer 8 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --offload --windows 2 --steps 3
+  measure "no_offload_control_2560x8" \
+    $SW --n_embd 2560 --n_layer 8 --n_head 32 --kv_heads 8 --n_inner 10240 --micro_bs 4 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --windows 2 --steps 3
+  measure "chip_filling_1536x16" \
+    $SW --n_embd 1536 --n_layer 16 --n_head 12 --kv_heads 6 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --windows 2 --steps 5
+  measure "chip_filling_2048x12" \
+    $SW --n_embd 2048 --n_layer 12 --n_head 16 --kv_heads 8 --micro_bs 8 --accum 8 --fused_loss --splash --mu_dtype bfloat16 --ckpt 1 --ckpt_policy dots_saveable --windows 2 --steps 5
+}
+
+# up to MAX_TRIES passes over the queue: each pass skips completed names, re-attempts
+# requeued ones; a pass with no failures ends the loop early
+for pass in $(seq 1 "$MAX_TRIES"); do
+  echo "=== queue pass $pass at $(date) ($(grep -cv '^try:' "$STATE") done)"
+  FAILURES=0
+  run_queue
+  if (( FAILURES == 0 )); then break; fi
 done
-echo "TPU never recovered"
-exit 1
+echo "=== queue finished at $(date): $(grep -cv '^try:' "$STATE") measurement(s) emitted to $RESULTS"
