@@ -16,11 +16,15 @@ Four layers over the single-process :class:`~dolomite_engine_tpu.serving.Serving
   "Serving fleet"): replica health monitoring (healthy -> suspect -> dead), crash/wedge
   recovery with bit-exact in-flight migration, drain/rejoin for rolling updates, and a
   deterministic fault-injection seam that makes all of it testable.
+- :mod:`metrics` — cross-replica aggregation (docs/OBSERVABILITY.md "Live metrics"):
+  per-replica ``EngineStats`` merged into fleet-level series for the ``/metrics``
+  endpoint, the ``fleet`` telemetry record, and router policy.
 """
 
 from .disagg import DisaggregatedEngine, KVHandoff
 from .faults import Fault, FaultInjector, InjectedFault
 from .health import ReplicaHealth, ReplicaHealthMonitor
+from .metrics import ClusterMetricsAggregator
 from .router import (
     DrainTimeoutError,
     EngineReplica,
@@ -37,6 +41,7 @@ from .sharded import (
 )
 
 __all__ = [
+    "ClusterMetricsAggregator",
     "DisaggregatedEngine",
     "DrainTimeoutError",
     "EngineReplica",

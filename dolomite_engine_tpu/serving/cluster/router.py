@@ -292,6 +292,9 @@ class Router:
         reroute_attempts: int = 3,
         reroute_backoff_s: float = 0.05,
         reroute_backoff_max_s: float = 1.0,
+        metrics: Any = None,
+        slo_monitor: Any = None,
+        flight_recorder: Any = None,
     ) -> None:
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -319,6 +322,20 @@ class Router:
             for replica in replicas:
                 health.register(replica.replica_id)
                 replica.monitor = health
+        # live observability plane (docs/OBSERVABILITY.md "Live metrics"; all default
+        # None — every hook is one None check and the off path writes nothing extra):
+        # a ClusterMetricsAggregator that emits a `fleet` record alongside each router
+        # record, a ServingSLOMonitor fed fleet-level signals (KV-handoff latency), and
+        # a FlightRecorder ring dumped when a replica is declared dead
+        self.metrics = metrics
+        self.slo_monitor = slo_monitor
+        self.flight_recorder = flight_recorder
+        self._obs_steps = 0  # router-step clock for flight-record/alert entries
+        # last step exception per replica, sync mode only: the ladder owns
+        # life/death, but the flight record wants to name the cause at _recover_dead
+        self._step_errors: dict[int, BaseException] = {}
+        self._handoff_transfers_seen = 0
+        self._handoff_latency_seen = 0.0
         self._dead: set[int] = set()  # quarantined after crash/wedge (until rejoin)
         self._parked: set[int] = set()  # drained for maintenance (until rejoin)
         self._started = False  # threaded mode is live (drain/rejoin manage threads)
@@ -459,7 +476,12 @@ class Router:
                 continue
             try:
                 worked = replica.step() or worked
-            except Exception:
+            except Exception as exc:
+                # remember the cause for _recover_dead's flight record — but NOT in
+                # the replica's sticky slot: that would short-circuit the next step()
+                # before the monitor heartbeat, starving the ladder of the repeat
+                # failures it needs to declare death
+                self._step_errors[replica.replica_id] = exc
                 worked = True  # the failed step consumed time; let recovery rerun us
         return worked
 
@@ -469,6 +491,7 @@ class Router:
         in-flight work)."""
         worked = self._step_routable()
         self._sweep_health()
+        self._observe_plane()
         return worked
 
     def drain(self, timeout_s: float | None = None) -> None:
@@ -513,6 +536,7 @@ class Router:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             self._sweep_health()
+            self._observe_plane()
             if self.health is None:
                 for replica in self.replicas:
                     if replica.error is not None:
@@ -535,6 +559,40 @@ class Router:
         for replica in self.replicas:
             replica.stop()
 
+    # ------------------------------------------------------------- observability
+
+    def _observe_plane(self) -> None:
+        """Feed the attached flight recorder and SLO monitor once per router
+        iteration (sync `step` and the threaded `wait` poll loop). One None check on
+        the off path; never writes telemetry records itself."""
+        if self.flight_recorder is None and self.slo_monitor is None:
+            return
+        self._obs_steps += 1
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(
+                self._obs_steps,
+                queue_depths=[r.queue_depth for r in self.replicas],
+                slots_active=[r.slots_active for r in self.replicas],
+                dead=sorted(self._dead) or None,
+                rerouted=self.stats.rerouted or None,
+                shed=self.stats.shed or None,
+            )
+        if self.slo_monitor is not None:
+            handoffs = [
+                r.engine.handoff
+                for r in self.replicas
+                if isinstance(r.engine, DisaggregatedEngine)
+            ]
+            transfers = sum(h.transfers for h in handoffs)
+            new = transfers - self._handoff_transfers_seen
+            if new > 0:
+                latency = sum(h._latency_sum for h in handoffs)
+                self.slo_monitor.observe_handoff(
+                    (latency - self._handoff_latency_seen) / new, step=self._obs_steps
+                )
+                self._handoff_transfers_seen = transfers
+                self._handoff_latency_seen = latency
+
     # ------------------------------------------------------------- fault recovery
 
     def _sweep_health(self) -> None:
@@ -553,6 +611,20 @@ class Router:
         replica.quarantine()
         self.stats.replica_crashes += 1
         get_telemetry().count("router_replica_crashes")
+        if self.flight_recorder is not None:
+            # the serving flight record: dump the recent router/engine-step ring at
+            # the moment of death, named for the replica that died. Threaded mode
+            # leaves the cause in the replica's sticky slot; sync mode parked it in
+            # _step_errors (the sticky slot would have starved the health ladder).
+            cause = replica.error
+            if cause is None:
+                cause = self._step_errors.get(replica.replica_id)
+            self.flight_recorder.record(
+                self._obs_steps,
+                replica_dead=replica.replica_id,
+                error=repr(cause) if cause is not None else None,
+            )
+            self.flight_recorder.dump(f"replica_dead:{replica.replica_id}", error=cause)
         orphans = replica.release_inflight()
         failed = self._place_orphans(orphans, src=replica)
         # capacity loss: shed what could not be placed, lowest tier (then youngest)
@@ -824,6 +896,10 @@ class Router:
                 sum(1 for s in states.values() if s == "healthy"),
             )
         telemetry.emit_record("router", **fields)
+        if self.metrics is not None:
+            # the fleet aggregate rides the router record's cadence, so readers get
+            # one merged cross-replica view per per-replica view
+            self.metrics.emit_fleet_record()
 
 
 def route_batch(router: Router, request_specs: list[dict]) -> list[RequestState]:

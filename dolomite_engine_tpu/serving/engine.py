@@ -61,7 +61,7 @@ from ..utils.program_signature import (
     capture_jit_signature,
     emit_program_signature_record,
 )
-from ..utils.telemetry import Telemetry, get_telemetry
+from ..utils.telemetry import QuantileSketch, Telemetry, get_telemetry
 from ..utils.tracing import RequestTrace
 from .kv_cache import TRASH_PAGE, HostSwapPool, PagedKVCachePool, SlotKVCachePool
 from .prefix_cache import PrefixCache, PrefixMatch
@@ -89,6 +89,13 @@ class EngineStats:
     The first token of each request is sampled inside prefill — it shows up in `ttft_s`
     samples, not in either rate. Cumulative over the engine's lifetime, like the
     telemetry window counters.
+
+    Latency samples (`ttft_s`, per-tier TTFT/ITL) are held in bounded
+    :class:`~dolomite_engine_tpu.utils.telemetry.QuantileSketch` reservoirs rather than
+    raw lists, so host memory stays O(capacity) per series on a long-running serve;
+    means stay exact (running sum) and p99 is nearest-rank over a uniform subsample —
+    bit-identical to the unbounded computation until a series exceeds the reservoir
+    capacity (4096 samples).
     """
 
     prefill_seconds: float = 0.0
@@ -96,7 +103,7 @@ class EngineStats:
     prefill_tokens: int = 0
     decode_tokens: int = 0
     decode_steps: int = 0
-    ttft_s: list[float] = field(default_factory=list)
+    ttft_s: QuantileSketch = field(default_factory=QuantileSketch)
     admitted: int = 0
     completed: int = 0
     rejected: int = 0
@@ -116,8 +123,8 @@ class EngineStats:
     session_hits: int = 0
     # per-tier latency samples: TTFT per admitted request, mean inter-token latency per
     # finished request (the quantities the per-tier SLOs target)
-    ttft_s_by_tier: dict[int, list[float]] = field(default_factory=dict)
-    itl_s_by_tier: dict[int, list[float]] = field(default_factory=dict)
+    ttft_s_by_tier: dict[int, QuantileSketch] = field(default_factory=dict)
+    itl_s_by_tier: dict[int, QuantileSketch] = field(default_factory=dict)
     admitted_by_tier: dict[int, int] = field(default_factory=dict)
     completed_by_tier: dict[int, int] = field(default_factory=dict)
     preempted_by_tier: dict[int, int] = field(default_factory=dict)
@@ -133,9 +140,7 @@ class EngineStats:
         return self.decode_tokens / self.decode_seconds
 
     def mean_ttft_s(self) -> float | None:
-        if not self.ttft_s:
-            return None
-        return sum(self.ttft_s) / len(self.ttft_s)
+        return self.ttft_s.mean()
 
     def prefix_hit_rate(self) -> float | None:
         total = self.prefix_hit_tokens + self.prefix_miss_tokens
@@ -161,14 +166,13 @@ class EngineStats:
         return _percentile(self.ttft_s_by_tier.get(tier, []), 0.99)
 
     def itl_mean_s(self, tier: int) -> float | None:
-        samples = self.itl_s_by_tier.get(tier, [])
-        if not samples:
-            return None
-        return sum(samples) / len(samples)
+        samples = self.itl_s_by_tier.get(tier)
+        return samples.mean() if samples is not None else None
 
 
-def _percentile(samples: list[float], q: float) -> float | None:
-    """Nearest-rank percentile (deterministic, no interpolation — bench-stable)."""
+def _percentile(samples, q: float) -> float | None:
+    """Nearest-rank percentile over a list or QuantileSketch (deterministic, no
+    interpolation — bench-stable)."""
     if not samples:
         return None
     ordered = sorted(samples)
@@ -349,6 +353,8 @@ class ServingEngine:
         prefill_only: bool = False,
         trace_requests: bool = False,
         signature_records: bool = False,
+        slo_monitor: Any = None,
+        flight_recorder: Any = None,
     ) -> None:
         if mesh is not None and sharding_rules is None:
             raise ValueError(
@@ -416,6 +422,11 @@ class ServingEngine:
         self.prefill_only = prefill_only
         self.trace_requests = trace_requests
         self.signature_records = signature_records
+        # live observability plane (docs/OBSERVABILITY.md "Live metrics"): both default
+        # to None and every hook below is a single `is None` check, so the off path's
+        # records/compiles are byte-identical to an engine built without them
+        self.slo_monitor = slo_monitor  # utils/diagnostics.ServingSLOMonitor
+        self.flight_recorder = flight_recorder  # utils/diagnostics.FlightRecorder
         # program name -> (jitted fn, abstract example args), recorded at each program's
         # first invocation so `program_signatures()` can re-lower the exact same shapes
         self._program_records: dict[str, tuple[Any, tuple]] = {}
@@ -761,9 +772,42 @@ class ServingEngine:
     def step(self) -> bool:
         """One scheduler iteration: reap deadline-expired slots, admit waiting requests
         into free slots, advance chunked prefills up to the budget (paged mode), run one
-        decode step over the slot batch. Returns whether any work remains."""
-        with self._scope():
-            self._step_in_scope()
+        decode step over the slot batch. Returns whether any work remains.
+
+        Observability hooks ride on the end of the step: the wall time feeds the
+        registry's step-time quantile sketch (in-memory only, no record), the flight
+        recorder ring gets one entry (and a dump if the step raised), and the SLO
+        burn-rate monitor observes the engine's signals. All three are no-ops on the
+        off path (`get_telemetry()` null / recorder and monitor None)."""
+        t0 = time.perf_counter()
+        try:
+            with self._scope():
+                self._step_in_scope()
+        except Exception as error:
+            if self.flight_recorder is not None:
+                from ..utils.diagnostics import crash_reason
+
+                self.flight_recorder.record(
+                    self._step_count,
+                    replica_id=self.replica_id,
+                    queue_depth=self.scheduler.queue_depth,
+                    slots_active=self.pool.num_active,
+                    error=repr(error),
+                )
+                self.flight_recorder.dump(crash_reason(error), error=error)
+            raise
+        get_telemetry().observe("serving/step_s", time.perf_counter() - t0)
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(
+                self._step_count,
+                replica_id=self.replica_id,
+                queue_depth=self.scheduler.queue_depth,
+                slots_active=self.pool.num_active,
+                completed=self.stats.completed,
+                preemptions=self.stats.preemptions or None,
+            )
+        if self.slo_monitor is not None:
+            self.slo_monitor.observe_engine(self)
         if (
             self.record_interval
             and self._step_count - self._last_record_step >= self.record_interval
@@ -936,7 +980,10 @@ class ServingEngine:
         state.first_token_t = self.scheduler.clock()
         if state.ttft_s is not None:
             self.stats.ttft_s.append(state.ttft_s)
-            self.stats.ttft_s_by_tier.setdefault(request.priority, []).append(state.ttft_s)
+            self.stats.ttft_s_by_tier.setdefault(
+                request.priority, QuantileSketch()
+            ).append(state.ttft_s)
+            get_telemetry().observe("serving/ttft_s", state.ttft_s)
         self._slot_states[slot] = state
         self._tokens[slot] = first_token
         self._rngs[slot] = np.array(carry)
@@ -1508,7 +1555,10 @@ class ServingEngine:
             if state.ttft_s is not None:
                 self.stats.ttft_s.append(state.ttft_s)
                 tier = state.request.priority
-                self.stats.ttft_s_by_tier.setdefault(tier, []).append(state.ttft_s)
+                self.stats.ttft_s_by_tier.setdefault(tier, QuantileSketch()).append(
+                    state.ttft_s
+                )
+                get_telemetry().observe("serving/ttft_s", state.ttft_s)
             self._tokens[slot] = first_token
             self._rngs[slot] = np.array(carry)
             state.rng_steps = 1  # the sampling chunk consumed one split of request.rng
@@ -1868,7 +1918,8 @@ class ServingEngine:
             get_telemetry().count("serving_requests_cancelled")
         if state.first_token_t is not None and state.num_generated > 1:
             itl = (state.finish_t - state.first_token_t) / (state.num_generated - 1)
-            self.stats.itl_s_by_tier.setdefault(tier, []).append(itl)
+            self.stats.itl_s_by_tier.setdefault(tier, QuantileSketch()).append(itl)
+            get_telemetry().observe("serving/itl_s", itl)
         tr = state.trace
         if tr is not None:
             # close whatever phase the request died in, then the root, and emit the

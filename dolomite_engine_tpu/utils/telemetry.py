@@ -27,6 +27,8 @@ Sink schema (one JSON object per line; see docs/OBSERVABILITY.md):
      "ttft_ms", "prefill_tok_s", "decode_tok_s", "counters"}  # serving engine (serving/engine.py)
     {"kind": "trace",  "ts", "rank", "step", "trace_id", "request_id", "spans"}  # per-request
                                              # span tree (utils/tracing.py, --trace only)
+    {"kind": "fleet",  "ts", "rank", "step", "replicas", "queue_depth", ..., "tiers",
+     "per_replica"}                          # cross-replica aggregate (serving/cluster/metrics.py)
     {"kind": "run_end","ts", "rank", "step", "status", "counters"}
 
 The full kind -> required-field table is :data:`RECORD_SCHEMA`;
@@ -171,6 +173,28 @@ RECORD_SCHEMA: dict[str, tuple[str, ...]] = {
     # tools/trace_export.py renders Perfetto timelines and tools/trace_analyze.py the
     # critical-path TTFT attribution from these records.
     "trace": ("trace_id", "request_id", "spans"),
+    # cross-replica fleet aggregate (serving/cluster/metrics.py ClusterMetricsAggregator):
+    # per-replica EngineStats merged into one fleet-level view. Totals are sums over live
+    # replicas; `tiers` merges every replica's per-tier series (ttft_p99_ms is computed over
+    # the pooled samples, not a mean of means); `per_replica` maps replica_id -> its slice
+    # (queue_depth, slots_active, num_slots, pages_in_use, occupancy, admitted, completed,
+    # preemptions, sessions_live, accept_rate, health). Emitted only when an aggregator is
+    # attached (--metrics-port / Router(metrics=...)); the off path never writes this kind.
+    "fleet": (
+        "replicas",
+        "queue_depth",
+        "slots_active",
+        "num_slots",
+        "admitted",
+        "completed",
+        "preempted",
+        "rejected",
+        "accept_rate",
+        "sessions_live",
+        "health",
+        "tiers",
+        "per_replica",
+    ),
     # compiled-program perf signatures (utils/program_signature.py): the run self-reports
     # what XLA built for its hot jitted programs — cost_analysis flops/bytes, donation
     # count, HLO features, and (when captured with compile=True) the memory_analysis
@@ -364,6 +388,82 @@ def collect_memory_gauges() -> dict[str, int]:
     return gauges
 
 
+def nearest_rank(ordered, q: float):
+    """Nearest-rank quantile over an already-sorted sequence (the serving engine's p99
+    convention: rank = ceil(q * n), clamped into range). None on empty input."""
+    n = len(ordered)
+    if n == 0:
+        return None
+    rank = min(n - 1, max(0, int(-(-q * n // 1)) - 1))
+    return ordered[rank]
+
+
+class QuantileSketch:
+    """Bounded nearest-rank quantile sketch: fixed-size uniform reservoir + exact running
+    count/sum.
+
+    Replaces the unbounded per-metric sample lists (``EngineStats.ttft_s`` et al.) so a
+    long-running serve holds at most ``capacity`` floats per series while quantile queries
+    stay nearest-rank over a uniform subsample. Below capacity the reservoir *is* the full
+    stream in insertion order, so ``mean()``/``quantile()`` are bit-identical to the exact
+    list-based computation — the off path (short runs, every existing test) cannot observe
+    the bound. Replacement uses a deterministic 64-bit LCG seeded per sketch, so results are
+    reproducible for a given insertion order without touching any global RNG.
+
+    Not thread-safe on its own; :meth:`Telemetry.observe` wraps it in the registry lock.
+    """
+
+    __slots__ = ("capacity", "values", "count", "total", "_rng")
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 1:
+            raise ValueError(f"QuantileSketch capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.values: list[float] = []
+        self.count = 0  # samples offered over the stream's lifetime
+        self.total = 0.0  # exact running sum (mean never degrades to the subsample's)
+        self._rng = 0x9E3779B97F4A7C15
+
+    def append(self, value: float) -> None:
+        """Offer one sample (named ``append`` so it drops into list call sites)."""
+        value = float(value)
+        self.count += 1
+        self.total += value
+        if len(self.values) < self.capacity:
+            self.values.append(value)
+            return
+        # algorithm R: replace a random retained sample with probability capacity/count
+        self._rng = (self._rng * 6364136223846793005 + 1442695040888963407) % (1 << 64)
+        slot = self._rng % self.count
+        if slot < self.capacity:
+            self.values[slot] = value
+
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    def quantile(self, q: float) -> float | None:
+        return nearest_rank(sorted(self.values), q)
+
+    def snapshot(self) -> dict[str, float | int | None]:
+        ordered = sorted(self.values)
+        return {
+            "count": self.count,
+            "mean": self.mean(),
+            "p50": nearest_rank(ordered, 0.50),
+            "p90": nearest_rank(ordered, 0.90),
+            "p99": nearest_rank(ordered, 0.99),
+        }
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __iter__(self):
+        return iter(self.values)
+
+    def __bool__(self) -> bool:
+        return bool(self.values)
+
+
 def step_annotation(step: int):
     """Label one train step in captured traces (`StepTraceAnnotation` groups per-step work in
     the profiler UI and feeds its step-time histogram)."""
@@ -512,6 +612,7 @@ class Telemetry:
         self._lock = threading.Lock()
         self.counters: dict[str, int] = {name: 0 for name in CANONICAL_COUNTERS}
         self.gauges: dict[str, Any] = {}
+        self._sketches: dict[str, QuantileSketch] = {}
         self._buckets: dict[str, float] = {k: 0.0 for k in GOODPUT_BUCKETS}
         self._step_times: list[float] = []
         self._window_start = time.perf_counter()
@@ -583,6 +684,46 @@ class Telemetry:
     def gauge(self, name: str, value) -> None:
         with self._lock:
             self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Offer one latency/duration sample to the named in-memory quantile sketch
+        (TTFT/ITL/step-time). Pure registry state: nothing is written to the sink, so the
+        serving engine feeds these unconditionally without touching record byte-identity.
+        Non-finite samples are dropped (a NaN would poison the running sum)."""
+        value = float(value)
+        if value != value or value in (float("inf"), float("-inf")):
+            return
+        with self._lock:
+            sketch = self._sketches.get(name)
+            if sketch is None:
+                sketch = self._sketches[name] = QuantileSketch()
+            sketch.append(value)
+
+    # ---------------------------------------------------------------- snapshots
+    # The live observability plane (serving/obs_server.py) scrapes these instead of
+    # tailing the JSONL sink: point-in-time copies taken under the registry lock, safe
+    # to read from the HTTP thread while engine/router threads keep writing.
+
+    def counters_snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    def gauges_snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self.gauges)
+
+    def quantiles_snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {name: sketch.snapshot() for name, sketch in self._sketches.items()}
+
+    def snapshot(self) -> dict[str, dict]:
+        """Counters + gauges + quantile summaries in one locked pass."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "quantiles": {n: s.snapshot() for n, s in self._sketches.items()},
+            }
 
     def event(self, name: str, step: int | None = None, **fields) -> None:
         record = {"kind": "event", "event": name}
@@ -754,6 +895,21 @@ class _NullTelemetry:
 
     def emit_record(self, kind, step=None, **fields) -> None:
         pass
+
+    def observe(self, name, value) -> None:
+        pass
+
+    def counters_snapshot(self) -> dict:
+        return {}
+
+    def gauges_snapshot(self) -> dict:
+        return {}
+
+    def quantiles_snapshot(self) -> dict:
+        return {}
+
+    def snapshot(self) -> dict:
+        return {"counters": {}, "gauges": {}, "quantiles": {}}
 
     def timer(self, bucket):
         return nullcontext()

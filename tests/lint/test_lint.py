@@ -212,6 +212,46 @@ def test_telemetry_rules_fire_on_undeclared_names(tmp_path):
     assert dead, "declared-but-unused names must be reported"
 
 
+def test_telemetry_dead_declaration_names_exact_set():
+    """Planted registry: the dead-declaration rule reports exactly the declared names
+    with no emit site — the guarantee the live /metrics endpoint leans on (every name
+    it renders has a writer somewhere in the package)."""
+    import ast
+
+    from tools.lint.checkers.telemetry import reverse_errors, scan_tree
+
+    tables = {
+        "counters": {"live_counter", "dead_counter"},
+        "events": set(),
+        "gauges": {"live/gauge", "dead/gauge"},
+        "records": {"live_kind": ("step",), "dead_kind": ("step",)},
+    }
+    source = (
+        "get_telemetry().count('live_counter')\n"
+        "get_telemetry().gauge('live/gauge', 1.0)\n"
+        "get_telemetry().emit_record('live_kind', step=0)\n"
+    )
+    errors, usage = scan_tree(ast.parse(source), "fixture.py", tables)
+    assert errors == []  # everything emitted is declared
+    dead = sorted(message.split("'")[1] for message in reverse_errors(tables, usage))
+    assert dead == ["dead/gauge", "dead_counter", "dead_kind"]
+
+
+def test_telemetry_dead_declaration_clean_on_real_registry():
+    """Every KNOWN_COUNTERS / KNOWN_GAUGES name (and record kind, incl. `fleet`) has at
+    least one emit site in the real package — scrape parity starts here."""
+    checker = TelemetryChecker()
+    files = [
+        os.path.join(root, name)
+        for root, _, names in os.walk(os.path.join(REPO_ROOT, "dolomite_engine_tpu"))
+        for name in names
+        if name.endswith(".py")
+    ]
+    result = run_checkers([checker], repo_root=REPO_ROOT, files=files, baseline=Counter())
+    dead = [f for f in result.new_findings if f.rule == "telemetry-dead-declaration"]
+    assert dead == [], [f.message for f in dead]
+
+
 def test_telemetry_shim_keeps_script_api(tmp_path):
     """scripts/check_telemetry_schema.py stays a working standalone entrypoint."""
     import importlib.util

@@ -1,0 +1,575 @@
+"""Live serving observability plane (serving/obs_server.py + cluster/metrics.py +
+utils/diagnostics.ServingSLOMonitor + the bounded EngineStats reservoirs).
+
+The load-bearing invariants, mirroring docs/OBSERVABILITY.md "Live metrics":
+
+- **scrape parity**: every KNOWN_COUNTERS / KNOWN_GAUGES name appears in a `/metrics`
+  scrape (0 when unwritten), plus the dynamic per-replica / per-tier fleet series —
+  the schema tables and the live endpoint can never drift apart;
+- **/healthz follows the ladder**: 200 while the fleet is live, 503 the moment an
+  injected crash (serving/cluster/faults.py) gets a replica declared dead, naming it;
+- **the off path is byte-identical**: no --metrics-port, no alerts, no recorder =>
+  the sink carries exactly the pre-observability record stream and the same tokens;
+- **burn-rate alerts are tier-precise**: a two-tier overload fires `ttft_burn_rate`
+  anomalies for the violated tier only, once per sustained burn;
+- **the flight recorder survives death**: a replica killed mid-decode dumps a ring
+  naming the dead replica; an unhandled engine exception dumps with its crash reason;
+- **reservoirs are bounded**: EngineStats latency samples live in fixed-size
+  reservoir sketches — exact below capacity, p99 within tolerance above it.
+
+Same tiny-model memoization as tests/test_serving_faults.py.
+"""
+
+import json
+import math
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dolomite_engine_tpu.models.gpt_dolomite import GPTDolomiteForCausalLM
+from dolomite_engine_tpu.serving import (
+    ClusterMetricsAggregator,
+    EngineReplica,
+    Fault,
+    FaultInjector,
+    ObservabilityServer,
+    ReplicaHealth,
+    Router,
+    ServingEngine,
+    TierSLO,
+    serve_batch,
+)
+from dolomite_engine_tpu.serving.obs_server import prometheus_name
+from dolomite_engine_tpu.utils.diagnostics import FlightRecorder, ServingSLOMonitor
+from dolomite_engine_tpu.utils.telemetry import (
+    KNOWN_COUNTERS,
+    KNOWN_GAUGES,
+    QuantileSketch,
+    Telemetry,
+    get_telemetry,
+    install_telemetry,
+    nearest_rank,
+    uninstall_telemetry,
+)
+
+from .test_commons import get_dense_test_config
+
+PAGE = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    uninstall_telemetry()
+
+
+def _tiny_model():
+    config = get_dense_test_config("gqa", "rope", normalization_function="rmsnorm")
+    model = GPTDolomiteForCausalLM(config=config)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return config, model, params
+
+
+_STATE: dict = {}
+
+
+def _model():
+    if "model" not in _STATE:
+        _STATE["model"] = _tiny_model()
+    return _STATE["model"]
+
+
+def _engine_kwargs(config, **overrides):
+    kwargs = dict(
+        num_slots=2,
+        max_len=96,
+        prefill_bucket_multiple=8,
+        eos_token_id=None,
+        pad_token_id=config.pad_token_id,
+        page_size=PAGE,
+        prefill_chunk_tokens=16,
+    )
+    kwargs.update(overrides)
+    return kwargs
+
+
+def _random_prompt(rs, config, length):
+    return list(map(int, rs.randint(3, config.vocab_size, length)))
+
+
+def _specs(config, count, max_new=4, seed=3, **extra):
+    rs = np.random.RandomState(seed)
+    return [
+        dict(prompt_ids=_random_prompt(rs, config, 12 + i), max_new_tokens=max_new, **extra)
+        for i in range(count)
+    ]
+
+
+def _read_sink(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _get(url):
+    """(status, body) without raising on 5xx — /healthz 503 is an expected answer."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as response:
+            return response.status, response.read().decode()
+    except urllib.error.HTTPError as error:
+        return error.code, error.read().decode()
+
+
+# ------------------------------------------------------------------ quantile sketch
+
+
+def test_quantile_sketch_exact_below_capacity():
+    sketch = QuantileSketch(capacity=64)
+    values = [float(v) for v in np.random.RandomState(0).rand(50)]
+    for v in values:
+        sketch.append(v)
+    assert len(sketch) == 50 and sketch.count == 50
+    assert list(sketch) == values  # bit-identical retention below capacity
+    assert sketch.mean() == pytest.approx(sum(values) / 50)
+    assert sketch.quantile(0.99) == nearest_rank(sorted(values), 0.99)
+
+
+def test_quantile_sketch_bounded_and_p99_close():
+    """Satellite: 10k samples through a 512-slot reservoir — memory stays bounded,
+    the running mean stays exact, and p99 lands within 5% of the exact p99."""
+    sketch = QuantileSketch(capacity=512)
+    values = [float(v) for v in np.random.RandomState(7).permutation(10_000)]
+    for v in values:
+        sketch.append(v)
+    assert len(sketch) == 512  # bounded: reservoir never grows past capacity
+    assert sketch.count == 10_000
+    assert sketch.mean() == pytest.approx(sum(values) / len(values))
+    exact = nearest_rank(sorted(values), 0.99)
+    assert abs(sketch.quantile(0.99) - exact) <= 0.05 * exact
+
+
+def test_quantile_sketch_deterministic():
+    a, b = QuantileSketch(capacity=8), QuantileSketch(capacity=8)
+    for v in range(1000):
+        a.append(float(v))
+        b.append(float(v))
+    assert list(a) == list(b)  # seeded LCG: replacement is reproducible
+    with pytest.raises(ValueError):
+        QuantileSketch(capacity=0)
+
+
+def test_telemetry_snapshot_api():
+    telemetry = Telemetry()  # sinkless: pure in-memory registry
+    telemetry.count("requests_admitted", 3)
+    telemetry.gauge("serving/queue_depth", 5)
+    telemetry.observe("serving/ttft_s", 0.25)
+    telemetry.observe("serving/ttft_s", float("nan"))  # dropped, never poisons p99
+    telemetry.observe("serving/ttft_s", float("inf"))
+    snapshot = telemetry.snapshot()
+    assert snapshot["counters"]["requests_admitted"] == 3
+    assert snapshot["gauges"]["serving/queue_depth"] == 5
+    assert snapshot["quantiles"]["serving/ttft_s"]["count"] == 1
+    assert snapshot["quantiles"]["serving/ttft_s"]["p99"] == 0.25
+    # snapshots are copies: mutating them never reaches the registry
+    snapshot["counters"]["requests_admitted"] = 999
+    assert telemetry.counters_snapshot()["requests_admitted"] == 3
+    # the uninstalled registry answers the same shape (obs server on a bare process)
+    uninstall_telemetry()
+    null_snapshot = get_telemetry().snapshot()
+    assert null_snapshot == {"counters": {}, "gauges": {}, "quantiles": {}}
+
+
+# ------------------------------------------------------------------ shared fleet run
+
+
+def _fleet_run():
+    """One two-replica served workload + aggregator + sink, shared by the read-only
+    endpoint tests (they only scrape/aggregate, never mutate engine state)."""
+    if "fleet_run" not in _STATE:
+        import tempfile
+
+        config, model, params = _model()
+        sink = tempfile.mktemp(suffix=".jsonl", prefix="obs_fleet_run_")
+        telemetry = Telemetry(sink_path=sink, rank=0)
+        install_telemetry(telemetry)
+        try:
+            engines = [
+                ServingEngine(
+                    model,
+                    params,
+                    tier_slos={0: TierSLO(ttft_target_s=60.0)},
+                    **_engine_kwargs(config),
+                )
+                for _ in range(2)
+            ]
+            router = Router([EngineReplica(i, e) for i, e in enumerate(engines)])
+            states = [router.submit(**s) for s in _specs(config, 4)]
+            router.drain(timeout_s=120.0)
+        finally:
+            telemetry.close()
+            uninstall_telemetry()
+        _STATE["fleet_run"] = (router, states, sink)
+    return _STATE["fleet_run"]
+
+
+def test_fleet_snapshot_sums_replicas():
+    router, states, _ = _fleet_run()
+    aggregator = ClusterMetricsAggregator.for_router(router)
+    snapshot = aggregator.fleet_snapshot()
+    engines = [r.engine for r in router.replicas]
+    assert snapshot["replicas"] == 2
+    assert snapshot["admitted"] == sum(e.stats.admitted for e in engines) == 4
+    assert snapshot["completed"] == sum(e.stats.completed for e in engines) == 4
+    assert snapshot["num_slots"] == sum(e.pool.num_slots for e in engines)
+    assert set(snapshot["per_replica"]) == {"0", "1"}
+    assert snapshot["health"] == {"0": "healthy", "1": "healthy"}
+    # per-tier p99 pools samples across replicas (never a mean of per-replica p99s)
+    pooled = sorted(
+        t for e in engines for t in (e.stats.ttft_s_by_tier.get(0) or [])
+    )
+    tier0 = snapshot["tiers"]["0"]
+    assert tier0["ttft_p99_ms"] == pytest.approx(nearest_rank(pooled, 0.99) * 1e3, rel=1e-3)
+    assert tier0["admitted"] == 4
+    # the labeled series carry the same numbers under replica/tier labels
+    series = {(name, tuple(sorted(labels.items()))): value for name, labels, value in aggregator.series()}
+    assert series[("fleet/replicas", ())] == 2.0
+    assert series[("serving/admitted", (("replica_id", "0"),))] == engines[0].stats.admitted
+    assert series[("serving/tier_admitted", (("tier", "0"),))] == 4.0
+
+
+def test_fleet_record_round_trips_through_summary(tmp_path):
+    router, _, _ = _fleet_run()
+    sink = tmp_path / "fleet_record.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        snapshot = ClusterMetricsAggregator.for_router(router).emit_fleet_record(step=11)
+    finally:
+        telemetry.close()
+        uninstall_telemetry()
+    (record,) = [r for r in _read_sink(sink) if r["kind"] == "fleet"]
+    from dolomite_engine_tpu.utils.telemetry import RECORD_SCHEMA
+
+    assert set(RECORD_SCHEMA["fleet"]) <= set(record)
+    assert record["replicas"] == snapshot["replicas"] == 2
+    from tools.telemetry_summary import summarize
+
+    text = summarize([record])
+    assert "fleet aggregate: 2 replica(s), 4/4 done" in text
+    assert "2/2 healthy" in text and "tier 0:" in text
+
+
+def test_metrics_scrape_parity_over_http():
+    """The acceptance gate: while a served fleet is attached, one `/metrics` scrape
+    contains every KNOWN counter/gauge name plus the per-replica and per-tier fleet
+    series; /healthz is 200 and /statusz parses."""
+    router, _, _ = _fleet_run()
+    server = ObservabilityServer(
+        0, aggregator=ClusterMetricsAggregator.for_router(router)
+    ).start()
+    try:
+        status, body = _get(f"{server.url}/metrics")
+        assert status == 200
+        for name in KNOWN_COUNTERS:
+            assert f"\n{prometheus_name(name, counter=True)} " in "\n" + body, name
+        for name in KNOWN_GAUGES:
+            assert f"\n{prometheus_name(name)} " in "\n" + body, name
+        # the dynamic fleet series, labeled
+        assert 'dolomite_serving_queue_depth{replica_id="0"} ' in body
+        assert 'dolomite_serving_tier_ttft_p99_ms{tier="0"} ' in body
+        assert "dolomite_fleet_replicas 2" in body
+
+        status, body = _get(f"{server.url}/healthz")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] == "ok" and payload["dead"] == []
+        assert payload["replicas"] == {"0": "healthy", "1": "healthy"}
+
+        status, body = _get(f"{server.url}/statusz")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["fleet"]["replicas"] == 2
+        assert "telemetry" in payload
+
+        status, _ = _get(f"{server.url}/nonsense")
+        assert status == 404
+    finally:
+        server.stop()
+
+
+def test_metrics_renders_live_quantiles():
+    """A serving run feeds the registry's latency sketches unconditionally; a scrape
+    renders them as Prometheus summaries with quantile labels."""
+    config, model, params = _model()
+    telemetry = Telemetry()  # sinkless: observe() is in-memory only
+    install_telemetry(telemetry)
+    engine = ServingEngine(model, params, **_engine_kwargs(config))
+    serve_batch(engine, _specs(config, 2, seed=5))
+    body = ObservabilityServer(0).render_metrics()
+    assert '\ndolomite_serving_ttft_s{quantile="0.99"} ' in body
+    assert "\ndolomite_serving_ttft_s_count 2" in body
+    assert '\ndolomite_serving_step_s{quantile="0.50"} ' in body
+    assert "\ndolomite_serving_itl_s_count " in body
+
+
+def test_healthz_flips_on_injected_crash():
+    """Fault-injected crash mid-decode: once the router declares the replica dead,
+    /healthz flips to 503 and names it; the survivors keep the fleet serving."""
+    config, model, params = _model()
+    injector = FaultInjector([Fault(kind="crash", replica_id=0, at=2)])
+    replicas = [
+        EngineReplica(
+            i, ServingEngine(model, params, **_engine_kwargs(config)), fault_injector=injector
+        )
+        for i in range(2)
+    ]
+    from dolomite_engine_tpu.serving import ReplicaHealthMonitor
+
+    router = Router(
+        replicas,
+        health=ReplicaHealthMonitor(
+            max_consecutive_exceptions=2, suspect_after_s=30.0, dead_after_s=60.0
+        ),
+    )
+    server = ObservabilityServer(
+        0, aggregator=ClusterMetricsAggregator.for_router(router)
+    ).start()
+    try:
+        status, _ = _get(f"{server.url}/healthz")
+        assert status == 200  # live fleet before the fault fires
+
+        states = [router.submit(**s) for s in _specs(config, 3, seed=6)]
+        router.drain(timeout_s=120.0)
+        assert all(s.status.value == "completed" for s in states)
+        assert router.health.state(0) is ReplicaHealth.dead
+
+        status, body = _get(f"{server.url}/healthz")
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["status"] == "unhealthy"
+        assert payload["dead"] == ["0"]  # the endpoint names the dead replica
+        assert payload["replicas"]["1"] == "healthy"
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------------ SLO burn-rate
+
+
+def test_two_tier_overload_alerts_violated_tier_only(tmp_path):
+    """Seeded two-tier overload: tier 2's TTFT target is unmeetable, tier 0's is
+    generous. The burn-rate monitor must fire `ttft_burn_rate` anomalies for tier 2
+    only — once per sustained burn, with the budget numbers on the event."""
+    config, model, params = _model()
+    sink = tmp_path / "alerts.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        monitor = ServingSLOMonitor(telemetry, fast_window=3, slow_window=10)
+        engine = ServingEngine(
+            model,
+            params,
+            tier_slos={0: TierSLO(ttft_target_s=60.0), 2: TierSLO(ttft_target_s=1e-6)},
+            slo_monitor=monitor,
+            **_engine_kwargs(config),
+        )
+        specs = _specs(config, 2, max_new=8, seed=8, priority=0) + _specs(
+            config, 2, max_new=8, seed=9, priority=2
+        )
+        states = serve_batch(engine, specs)
+        assert all(s.status.value == "completed" for s in states)
+    finally:
+        telemetry.close()
+        uninstall_telemetry()
+
+    assert monitor.alerts, "the violated tier must alert"
+    assert {a["signal"] for a in monitor.alerts} == {"ttft_burn_rate"}
+    assert {a["tier"] for a in monitor.alerts} == {2}  # tier 0 never pages
+    alert = monitor.alerts[0]
+    assert alert["ttft_p99_ms"] > alert["ttft_target_ms"]
+    assert alert["fast_burn_rate"] == 1.0
+    # hysteresis: the condition held the whole run, so the key fired exactly once
+    assert len([a for a in monitor.alerts if a["tier"] == 2]) == 1
+
+    events = [r for r in _read_sink(sink) if r.get("event") == "anomaly"]
+    assert len(events) == len(monitor.alerts)
+    from tools.telemetry_summary import summarize
+
+    text = summarize(_read_sink(sink))
+    assert "alerts: ttft_burn_rate x1" in text
+
+
+def test_burn_rate_gate_and_hysteresis():
+    """Unit: the multi-window gate needs a full fast window AND a burning slow window;
+    clearing the fast window re-arms the key."""
+    telemetry = Telemetry()
+    monitor = ServingSLOMonitor(telemetry, fast_window=3, slow_window=6)
+    key = ("ttft", 0, 1)
+    fields = {"signal": "ttft_burn_rate", "replica_id": 0, "tier": 1}
+    for violated in (True, True):
+        monitor._observe_burn(key, 0, violated, fields)
+    assert monitor.alerts == []  # fast window not yet full
+    monitor._observe_burn(key, 2, True, fields)
+    assert len(monitor.alerts) == 1  # 3/3 fast, 3/3 slow: fires
+    monitor._observe_burn(key, 3, True, fields)
+    assert len(monitor.alerts) == 1  # still burning: one alert per episode
+    for step in (4, 5, 6):
+        monitor._observe_burn(key, step, False, fields)  # fast window clears: re-arm
+    for step in (7, 8, 9):
+        monitor._observe_burn(key, step, True, fields)
+    # fast is 3/3 again but slow is 3/6 < slow_burn on step 8; by step 9 slow is 4/6
+    assert len(monitor.alerts) == 2
+    with pytest.raises(ValueError):
+        ServingSLOMonitor(telemetry, fast_window=5, slow_window=3)
+
+
+# ------------------------------------------------------------------ flight recorder
+
+
+def test_flight_record_dumped_on_replica_death(tmp_path):
+    """Injected mid-decode crash with the recorder attached to the router: the dump
+    names the dead replica in its reason and carries the ring of recent steps."""
+    config, model, params = _model()
+    injector = FaultInjector([Fault(kind="crash", replica_id=0, at=2)])
+    replicas = [
+        EngineReplica(
+            i, ServingEngine(model, params, **_engine_kwargs(config)), fault_injector=injector
+        )
+        for i in range(2)
+    ]
+    from dolomite_engine_tpu.serving import ReplicaHealthMonitor
+
+    dump_path = tmp_path / "flight-record-serving.json"
+    router = Router(
+        replicas,
+        health=ReplicaHealthMonitor(
+            max_consecutive_exceptions=2, suspect_after_s=30.0, dead_after_s=60.0
+        ),
+        flight_recorder=FlightRecorder(64, str(dump_path)),
+    )
+    states = [router.submit(**s) for s in _specs(config, 3, seed=10)]
+    router.drain(timeout_s=120.0)
+    assert all(s.status.value == "completed" for s in states)
+
+    assert dump_path.exists(), "replica death must dump the flight record"
+    payload = json.loads(dump_path.read_text())
+    assert payload["reason"] == "replica_dead:0"  # names the dead replica
+    assert payload["error"] is not None
+    assert payload["records"], "the ring must carry the steps leading up to death"
+    assert any("queue_depths" in r for r in payload["records"])
+    assert any(r.get("replica_dead") == 0 for r in payload["records"])
+
+
+def test_flight_record_dumped_on_engine_exception(tmp_path):
+    """An unhandled exception unwinding ServingEngine.step dumps the engine-side ring
+    with the crash-reason vocabulary, then re-raises."""
+    config, model, params = _model()
+    dump_path = tmp_path / "flight-record-engine.json"
+    engine = ServingEngine(
+        model,
+        params,
+        flight_recorder=FlightRecorder(64, str(dump_path)),
+        **_engine_kwargs(config),
+    )
+    states = serve_batch(engine, _specs(config, 1, max_new=2, seed=12))
+    assert states[0].status.value == "completed"
+
+    engine.submit(**_specs(config, 1, seed=13)[0])
+
+    def boom():
+        raise RuntimeError("injected engine fault")
+
+    engine._step_in_scope = boom
+    with pytest.raises(RuntimeError, match="injected engine fault"):
+        engine.step()
+    payload = json.loads(dump_path.read_text())
+    assert payload["reason"] == "exception:RuntimeError"
+    assert payload["records"][-1]["error"] == repr(RuntimeError("injected engine fault"))
+    assert all("replica_id" not in r or r["replica_id"] == 0 for r in payload["records"])
+
+
+# ------------------------------------------------------------------ off path
+
+
+def test_off_path_records_are_unchanged(tmp_path):
+    """No metrics port, no monitor, no recorder: the sink must carry exactly the
+    pre-observability record stream — no `fleet` records, no anomaly events, the same
+    serving/router field sets — while a concurrently-scraped run (observability ON but
+    nothing emitting) serves the same tokens with the same records."""
+    config, model, params = _model()
+
+    def run(sink, scraped):
+        telemetry = Telemetry(sink_path=str(sink), rank=0)
+        install_telemetry(telemetry)
+        try:
+            engines = [
+                ServingEngine(model, params, **_engine_kwargs(config)) for _ in range(2)
+            ]
+            router = Router([EngineReplica(i, e) for i, e in enumerate(engines)])
+            server = None
+            if scraped:
+                server = ObservabilityServer(
+                    0, aggregator=ClusterMetricsAggregator.for_router(router)
+                ).start()
+            try:
+                states = [router.submit(**s) for s in _specs(config, 4, seed=14)]
+                router.drain(timeout_s=120.0)
+                if scraped:  # scrapes mid-flight must not perturb the sink
+                    assert _get(f"{server.url}/metrics")[0] == 200
+                    assert _get(f"{server.url}/healthz")[0] == 200
+            finally:
+                if server is not None:
+                    server.stop()
+        finally:
+            telemetry.close()
+            uninstall_telemetry()
+        return [s.tokens for s in states], [r.engine for r in router.replicas]
+
+    tokens_off, engines_off = run(tmp_path / "off.jsonl", scraped=False)
+    tokens_on, engines_on = run(tmp_path / "on.jsonl", scraped=True)
+    assert tokens_off == tokens_on  # greedy decode: scraping never changes outputs
+    assert [e.decode_compiles for e in engines_off] == [e.decode_compiles for e in engines_on]
+
+    def normalize(records):
+        return [
+            {k: v for k, v in r.items() if k != "ts"} for r in records
+        ]
+
+    records_off = normalize(_read_sink(tmp_path / "off.jsonl"))
+    records_on = normalize(_read_sink(tmp_path / "on.jsonl"))
+    kinds = {r["kind"] for r in records_off}
+    assert "fleet" not in kinds and "anomaly" not in kinds
+    assert not any(r.get("event") == "anomaly" for r in records_off)
+    assert [r["kind"] for r in records_off] == [r["kind"] for r in records_on]
+    # timing-free fields are identical record-for-record: attaching the plane without
+    # emitting is invisible in the sink
+    timing_keys = (
+        "ttft_ms", "prefill_tok_s", "decode_tok_s", "handoff_latency_ms", "tiers",
+        "itl_ms",
+    )
+    for off, on in zip(records_off, records_on):
+        for record in (off, on):
+            for key in timing_keys:
+                record.pop(key, None)
+        assert off == on
+
+
+def test_stats_reservoirs_stay_bounded():
+    """Satellite: EngineStats latency samples are reservoir sketches, so a long-lived
+    replica's memory is flat — and the p99 the records report still tracks the exact
+    value (the sub-capacity regime is bit-exact; see the sketch unit test for above)."""
+    router, _, _ = _fleet_run()
+    for replica in router.replicas:
+        stats = replica.engine.stats
+        assert isinstance(stats.ttft_s, QuantileSketch)
+        assert len(stats.ttft_s) <= stats.ttft_s.capacity
+        for sketch in (*stats.ttft_s_by_tier.values(), *stats.itl_s_by_tier.values()):
+            assert isinstance(sketch, QuantileSketch)
+            assert len(sketch) <= sketch.capacity
+        if stats.ttft_s.count:
+            assert stats.mean_ttft_s() == pytest.approx(
+                stats.ttft_s.total / stats.ttft_s.count
+            )
+            assert math.isfinite(stats.ttft_p99_s(0) or 0.0)

@@ -410,6 +410,72 @@ def test_trace_export_emits_valid_perfetto_json(traced_sink, tmp_path):
     assert any(e["name"] == "preempt_park" for e in complete)
 
 
+def test_trace_export_survives_concurrent_replica_threads(tiny, tmp_path):
+    """Perfetto export under threaded serving: two replica threads finish requests (and
+    so write `trace` records) concurrently. The sink must stay line-atomic (every line
+    parses), span ids must be unique and monotonic within each trace (per-trace id
+    counters never interleave across threads), and the export must stay schema-valid
+    with one pid track per replica."""
+    from tools.trace_export import export_trace_events
+
+    config, model, params = tiny
+    sink = tmp_path / "telemetry.jsonl"
+    telemetry = Telemetry(sink_path=str(sink), rank=0)
+    install_telemetry(telemetry)
+    try:
+        engines = [
+            _engine(model, config, params, trace_requests=True) for _ in range(2)
+        ]
+        router = Router(
+            [EngineReplica(i, e) for i, e in enumerate(engines)],
+            trace_requests=True,
+        )
+        router.start()
+        try:
+            states = [
+                router.submit(**spec)
+                for spec in _specs(config, 6, length=12, max_new=4, seed=11)
+            ]
+            assert router.wait(timeout_s=120.0), "threaded fleet failed to drain"
+        finally:
+            router.stop()
+        assert all(s.status.value == "completed" for s in states)
+    finally:
+        telemetry.close()
+        uninstall_telemetry()
+
+    # line-atomic sink: concurrent writers never tear or interleave a record
+    with open(sink) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    traces = [r for r in records if r.get("kind") == "trace"]
+    assert len(traces) == 6
+    assert len({t["trace_id"] for t in traces}) == 6
+
+    for trace in traces:
+        ids = [s["id"] for s in trace["spans"]]
+        # per-trace id counter: unique and strictly increasing in creation order
+        assert len(ids) == len(set(ids))
+        assert ids == sorted(ids)
+        id_set = set(ids)
+        root = next(s for s in trace["spans"] if s["name"] == "request")
+        for span in trace["spans"]:
+            if span is not root:
+                assert span["parent"] in id_set  # no cross-trace leakage
+
+    payload = export_trace_events(traces)
+    events = payload["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert complete
+    assert {e["name"] for e in complete} <= set(KNOWN_SPANS)
+    # both replicas produced spans, each on its own pid track
+    pids = {e["pid"] for e in complete}
+    assert len(pids) >= 2
+    meta_names = {
+        e["args"]["name"] for e in events if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert any("replica" in n for n in meta_names), meta_names
+
+
 def test_trace_analyze_attributes_by_tier(traced_sink, capsys):
     from tools import trace_analyze
 

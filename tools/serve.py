@@ -169,6 +169,38 @@ def parse_args() -> argparse.Namespace:
         "record also writes a `program_signature` record (cost/donation/HLO features, "
         "utils/program_signature.py; docs/OBSERVABILITY.md 'Perf ledger')",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve live observability endpoints on 127.0.0.1:<port> while the batch "
+        "runs (docs/OBSERVABILITY.md 'Live metrics'): Prometheus /metrics, /healthz "
+        "(503 once any replica is declared dead), /statusz (fleet JSON). Also emits "
+        "`fleet` telemetry records (cross-replica aggregate) into --telemetry-sink. "
+        "0 binds an ephemeral port; off by default with byte-identical records",
+    )
+    p.add_argument(
+        "--slo-alerts",
+        action="store_true",
+        help="SLO burn-rate alerting over serving signals (per-tier TTFT p99 vs "
+        "--ttft-slo-ms, queue growth, accept-rate collapse, KV-handoff latency): "
+        "emits `anomaly` telemetry events with fast/slow burn-rate fields; off by "
+        "default with byte-identical records",
+    )
+    p.add_argument(
+        "--ttft-slo-ms",
+        type=float,
+        default=None,
+        help="TTFT p99 target (ms) for the submitted --priority tier; the SLO the "
+        "--slo-alerts burn-rate monitor gates against",
+    )
+    p.add_argument(
+        "--flight-record",
+        default=None,
+        help="serving flight recorder: ring-buffer recent engine/router step records "
+        "and dump them as JSON to this path on replica death or unhandled engine "
+        "exception (docs/OBSERVABILITY.md 'Live metrics')",
+    )
     p.add_argument("--max-waiting", type=int, default=128, help="waiting-queue bound")
     p.add_argument("--deadline-s", type=float, default=None, help="per-request wall budget")
     p.add_argument("--seed", type=int, default=0)
@@ -233,6 +265,26 @@ def main() -> None:
         telemetry = Telemetry(sink_path=args.telemetry_sink)
         install_telemetry(telemetry)
 
+    # live observability plane (all default-off; the off path builds none of this and
+    # its telemetry records stay byte-identical)
+    from dolomite_engine_tpu.utils.telemetry import get_telemetry
+
+    slo_monitor = None
+    if args.slo_alerts:
+        from dolomite_engine_tpu.utils.diagnostics import ServingSLOMonitor
+
+        slo_monitor = ServingSLOMonitor(get_telemetry())
+    flight_recorder = None
+    if args.flight_record:
+        from dolomite_engine_tpu.utils.diagnostics import FlightRecorder
+
+        flight_recorder = FlightRecorder(256, args.flight_record)
+    tier_slos = None
+    if args.ttft_slo_ms is not None:
+        from dolomite_engine_tpu.serving import TierSLO
+
+        tier_slos = {args.priority: TierSLO(ttft_target_s=args.ttft_slo_ms / 1e3)}
+
     draft_model = draft_params = None
     if args.draft_model:
         draft_wrapper = ModelWrapperForFinetuning(
@@ -283,6 +335,9 @@ def main() -> None:
             sharding_rules=rules,
             trace_requests=args.trace,
             signature_records=args.program_signatures,
+            tier_slos=tier_slos,
+            slo_monitor=slo_monitor,
+            flight_recorder=flight_recorder,
         )
         kwargs.update(overrides)
         return ServingEngine(model.model, params, **kwargs)
@@ -318,9 +373,28 @@ def main() -> None:
             record_interval=100,
             trace_requests=args.trace,
             health=ReplicaHealthMonitor() if args.health_monitoring else None,
+            slo_monitor=slo_monitor,
+            flight_recorder=flight_recorder,
         )
     else:
         engine = build_engine()
+
+    obs_server = None
+    if args.metrics_port is not None:
+        from dolomite_engine_tpu.serving import ClusterMetricsAggregator, ObservabilityServer
+
+        if router is not None:
+            aggregator = ClusterMetricsAggregator.for_router(router)
+            router.metrics = aggregator  # fleet records ride the router record cadence
+        else:
+            aggregator = ClusterMetricsAggregator([engine])
+        obs_server = ObservabilityServer(
+            args.metrics_port, aggregator=aggregator, slo_monitor=slo_monitor
+        ).start()
+        print(
+            f"observability: {obs_server.url}/metrics (/healthz, /statusz)",
+            file=sys.stderr,
+        )
 
     sampling = SamplingParams(
         do_sample=args.do_sample,
@@ -368,6 +442,19 @@ def main() -> None:
     finally:
         if out is not sys.stdout:
             out.close()
+
+    if obs_server is not None:
+        if router is None:
+            # router runs emit the aggregate on the router record cadence; single-engine
+            # runs get one final fleet record so the sink always carries the aggregate
+            obs_server.aggregator.emit_fleet_record()
+        obs_server.stop()
+    if slo_monitor is not None and slo_monitor.alerts:
+        by_signal: dict[str, int] = {}
+        for alert in slo_monitor.alerts:
+            by_signal[alert["signal"]] = by_signal.get(alert["signal"], 0) + 1
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(by_signal.items()))
+        print(f"slo alerts: {len(slo_monitor.alerts)} ({summary})", file=sys.stderr)
 
     if telemetry is not None:
         telemetry.close()

@@ -170,8 +170,21 @@ def summarize(records: list[dict]) -> str:
     model_reports = [r for r in records if r.get("kind") == "model_report"]
     servings = [r for r in records if r.get("kind") == "serving"]
     routers = [r for r in records if r.get("kind") == "router"]
+    fleets = [r for r in records if r.get("kind") == "fleet"]
     traces = [r for r in records if r.get("kind") == "trace"]
     signatures = [r for r in records if r.get("kind") == "program_signature"]
+
+    # tolerate sinks written by a newer schema: count-and-skip kinds this renderer
+    # does not know, never crash on them (forward compatibility for mixed fleets)
+    known_kinds = {
+        "step", "window", "event", "run_start", "run_end", "health", "model_report",
+        "serving", "router", "fleet", "trace", "program_signature",
+    }
+    unknown_kinds: dict[str, int] = {}
+    for record in records:
+        kind = str(record.get("kind", "?"))
+        if kind not in known_kinds:
+            unknown_kinds[kind] = unknown_kinds.get(kind, 0) + 1
 
     lines: list[str] = []
 
@@ -436,6 +449,38 @@ def summarize(records: list[dict]) -> str:
             lines.append(", ".join(fleet))
         lines.append("")
 
+    # ---------------------------------------------------------------- fleet aggregate
+    if fleets:
+        last = fleets[-1]  # totals are cumulative sums across replicas
+        parts = [
+            f"fleet aggregate: {last.get('replicas', '?')} replica(s), "
+            f"{last.get('completed', 0)}/{last.get('admitted', 0)} done "
+            f"({last.get('preempted', 0)} preempted, {last.get('rejected', 0)} rejected)"
+        ]
+        parts.append(
+            f"queue {last.get('queue_depth', 0)}, "
+            f"slots {last.get('slots_active', 0)}/{last.get('num_slots', 0)}"
+        )
+        if last.get("accept_rate") is not None:
+            parts.append(f"accept rate {100.0 * last['accept_rate']:.1f}%")
+        if last.get("sessions_live"):
+            parts.append(f"{last['sessions_live']} live session(s)")
+        health = last.get("health") or {}
+        if health:
+            healthy = sum(1 for s in health.values() if s == "healthy")
+            parts.append(f"{healthy}/{len(health)} healthy")
+        for tier, info in sorted(
+            (last.get("tiers") or {}).items(), key=lambda kv: int(kv[0])
+        ):
+            bits = [f"{(info or {}).get('completed', 0)}/{(info or {}).get('admitted', 0)} done"]
+            if (info or {}).get("ttft_p99_ms") is not None:
+                bits.append(f"p99 ttft {info['ttft_p99_ms']:.0f}ms")
+            if (info or {}).get("itl_mean_ms") is not None:
+                bits.append(f"itl {info['itl_mean_ms']:.1f}ms")
+            parts.append(f"tier {tier}: " + " ".join(bits))
+        lines.append(", ".join(parts) + f" ({len(fleets)} fleet record(s))")
+        lines.append("")
+
     # ---------------------------------------------------------------- traces
     if traces:
         # per-request distributed tracing (--trace): critical-path TTFT by tier.
@@ -504,9 +549,34 @@ def summarize(records: list[dict]) -> str:
             lines.append(f"({len(healths)} health record(s))")
             lines.append("")
 
+    # serving SLO alerts (utils/diagnostics.ServingSLOMonitor) get their own line with
+    # replica/tier attribution; everything else stays on the training "anomalies:" line
+    serving_signals = {
+        "ttft_burn_rate", "queue_growth", "accept_rate_collapse", "handoff_latency",
+    }
     anomalies = [e for e in events if e.get("event") == "anomaly"]
-    if anomalies:
+    alerts = [a for a in anomalies if str(a.get("signal", "?")) in serving_signals]
+    anomalies = [a for a in anomalies if a not in alerts]
+    if alerts:
         by_signal: dict[str, list] = {}
+        for alert in alerts:
+            by_signal.setdefault(str(alert.get("signal", "?")), []).append(alert)
+        parts = []
+        for signal_name in sorted(by_signal):
+            group = by_signal[signal_name]
+            where = sorted(
+                {
+                    f"#{a['replica_id']}" + (f"/tier{a['tier']}" if "tier" in a else "")
+                    for a in group
+                    if a.get("replica_id") is not None
+                }
+            )
+            suffix = f" [{', '.join(where)}]" if where else ""
+            parts.append(f"{signal_name} x{len(group)}{suffix}")
+        lines.append("alerts: " + ", ".join(parts))
+        lines.append("")
+    if anomalies:
+        by_signal = {}
         for anomaly in anomalies:
             by_signal.setdefault(str(anomaly.get("signal", "?")), []).append(
                 anomaly.get("step")
@@ -543,6 +613,11 @@ def summarize(records: list[dict]) -> str:
         )
         lines.append("")
 
+    if unknown_kinds:
+        skipped = ", ".join(f"{k} x{v}" for k, v in sorted(unknown_kinds.items()))
+        lines.append(f"(skipped records of unknown kind: {skipped})")
+        lines.append("")
+
     if not (
         steps
         or windows
@@ -552,6 +627,7 @@ def summarize(records: list[dict]) -> str:
         or model_reports
         or servings
         or routers
+        or fleets
         or traces
     ):
         lines.append("(no telemetry records found)")
